@@ -1,0 +1,25 @@
+"""mingpt_distributed_tpu: a TPU-native (JAX/XLA/Pallas/pjit) training framework
+with the capabilities of aponte411/minGPT-distributed, rebuilt from scratch.
+
+Layer map (mirrors SURVEY.md §1, TPU-first):
+  L0 launch/    — TPU pod bring-up + run-on-all-workers (slurm/ analogue)
+  L1 parallel/  — mesh, shardings, collectives, multi-host init (NCCL/DDP analogue)
+  L2 models/ ops/ — pure-function model over pytrees + Pallas kernels
+  L3 training/  — train step, trainer loop, optimizer, checkpoint (trainer.py analogue)
+  L4 config.py, data/, train.py — config, dataset, application entry
+"""
+
+from mingpt_distributed_tpu.config import (
+    ConfigError,
+    DataConfig,
+    ExperimentConfig,
+    GPTConfig,
+    MeshConfig,
+    MODEL_PRESETS,
+    OptimizerConfig,
+    TrainerConfig,
+    apply_overrides,
+    load_config,
+)
+
+__version__ = "0.1.0"

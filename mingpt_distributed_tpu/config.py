@@ -1,0 +1,397 @@
+"""Configuration layer: dataclasses + presets + YAML + CLI overrides.
+
+TPU-native re-design of the reference's config system
+(/root/reference/mingpt/model.py:38-59, /root/reference/mingpt/trainer.py:21-29,
+/root/reference/mingpt/char_dataset.py:12-17, /root/reference/mingpt/train.py:36-39,
+/root/reference/mingpt/gpt2_config.yaml): the same four-section schema
+(model / optimizer / data / trainer), with the reference's latent config bugs
+fixed by construction:
+
+* one canonical spelling ``n_embd`` everywhere (the reference mixed ``n_embed``
+  and ``n_embd`` across dataclass, preset table, and YAML — bugs B2/B15 in
+  SURVEY.md §2.9); ``n_embed`` is accepted as an input alias and normalised.
+* preset-vs-explicit dims validated as XOR (the reference's condition at
+  model.py:267 inverted the check — bug B1), matching upstream minGPT's intent.
+* unknown keys are rejected at load time with the valid key set in the error.
+
+No Hydra dependency: a plain YAML file plus dotted ``section.key=value`` CLI
+overrides reproduces the Hydra surface actually used by the reference
+(/root/reference/mingpt/train.py:30, gpt2_config.yaml), without relocating the
+run dir (the reference had to disable that relocation, gpt2_config.yaml:21-23).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional, Sequence, Tuple
+
+import yaml
+
+# ---------------------------------------------------------------------------
+# Model presets
+# ---------------------------------------------------------------------------
+
+# Preset table mirroring /root/reference/mingpt/model.py:269-294 (values are
+# public GPT-2/minGPT lore, cf. reference README.md:86-143), plus TPU-era
+# additions (llama family for the RoPE/SwiGLU retrofit, BASELINE config #5).
+MODEL_PRESETS: dict[str, dict[str, Any]] = {
+    # name            layers heads  width   (params)
+    "openai-gpt":    dict(n_layer=12, n_head=12, n_embd=768),    # 117M
+    "gpt2":          dict(n_layer=12, n_head=12, n_embd=768),    # 124M
+    "gpt2-medium":   dict(n_layer=24, n_head=16, n_embd=1024),   # 350M
+    "gpt2-large":    dict(n_layer=36, n_head=20, n_embd=1280),   # 774M
+    "gpt2-xl":       dict(n_layer=48, n_head=25, n_embd=1600),   # 1558M
+    "gopher-44m":    dict(n_layer=8,  n_head=16, n_embd=512),
+    "gpt-mini":      dict(n_layer=6,  n_head=6,  n_embd=192),
+    "gpt-micro":     dict(n_layer=4,  n_head=4,  n_embd=128),
+    "gpt-nano":      dict(n_layer=3,  n_head=3,  n_embd=48),
+    # Llama-style presets (rotary + SwiGLU + RMSNorm), beyond-parity targets.
+    "llama-tiny":    dict(n_layer=4,  n_head=4,  n_embd=256,  n_kv_head=2,
+                          rope=True, swiglu=True, rmsnorm=True, tie_weights=False),
+    "llama-3-8b":    dict(n_layer=32, n_head=32, n_embd=4096, n_kv_head=8,
+                          rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
+                          vocab_size=128256, block_size=8192, ffn_mult=3.5),
+}
+
+
+class ConfigError(ValueError):
+    """Raised for invalid or inconsistent configuration."""
+
+
+def _reject_unknown(cls, kwargs: Mapping[str, Any]) -> dict[str, Any]:
+    valid = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(kwargs) - valid
+    if unknown:
+        raise ConfigError(
+            f"{cls.__name__}: unknown key(s) {sorted(unknown)}; "
+            f"valid keys: {sorted(valid)}"
+        )
+    return dict(kwargs)
+
+
+@dataclass
+class GPTConfig:
+    """Model hyperparameters (reference GPTConfig, model.py:38-51).
+
+    Either give ``model_type`` (a preset name) or the explicit dims
+    ``n_layer/n_head/n_embd`` — exactly one of the two (upstream minGPT's
+    XOR assert; the reference fork broke this, SURVEY.md B1).
+    """
+
+    model_type: Optional[str] = None
+    n_layer: Optional[int] = None
+    n_head: Optional[int] = None
+    n_embd: Optional[int] = None
+    vocab_size: int = 50257
+    block_size: int = 1024
+    # Dropout rates (reference: embed_drop/resid_drop/attn_drop, all 0.1).
+    embd_pdrop: float = 0.1
+    resid_pdrop: float = 0.1
+    attn_pdrop: float = 0.1
+    # --- TPU-native extensions -------------------------------------------
+    # Attention implementation: "einsum" (reference semantics, oracle),
+    # "flash" (Pallas blockwise kernel), "ring" (sequence-parallel ring
+    # attention over the mesh's `sp` axis).
+    attention: str = "einsum"
+    # Compute dtype for activations; params are kept in float32.
+    dtype: str = "bfloat16"
+    # Rematerialise each block in backward (jax.checkpoint) to trade FLOPs
+    # for HBM.
+    remat: bool = False
+    # Tie the LM head to the token embedding (GPT-2 ties; the reference's
+    # head is an independent bias-free Linear, model.py:249 — keep that as
+    # the default for parity).
+    tie_weights: bool = False
+    # Llama-retrofit toggles (BASELINE config #5).
+    rope: bool = False
+    rope_theta: float = 10000.0
+    swiglu: bool = False
+    rmsnorm: bool = False
+    n_kv_head: Optional[int] = None  # grouped-query attention; None = n_head
+    ffn_mult: float = 4.0  # MLP expansion factor (reference hardcodes 4x)
+
+    @classmethod
+    def make(cls, **kwargs: Any) -> "GPTConfig":
+        """Build + resolve + validate in one step (accepts n_embed alias)."""
+        kwargs = dict(kwargs)
+        if "n_embed" in kwargs:  # normalise the reference's stray spelling
+            kwargs.setdefault("n_embd", kwargs.pop("n_embed"))
+        cfg = cls(**_reject_unknown(cls, kwargs))
+        return cfg.resolved()
+
+    def resolved(self) -> "GPTConfig":
+        """Apply the preset table and validate (XOR semantics, fixing B1)."""
+        type_given = self.model_type is not None
+        dims_given = all(
+            v is not None for v in (self.n_layer, self.n_head, self.n_embd)
+        )
+        any_dim_given = any(
+            v is not None for v in (self.n_layer, self.n_head, self.n_embd)
+        )
+        if type_given and any_dim_given:
+            raise ConfigError(
+                "give either model_type (a preset) or explicit "
+                "n_layer/n_head/n_embd, not both"
+            )
+        if not type_given and not dims_given:
+            raise ConfigError(
+                "model underspecified: give model_type or all of "
+                "n_layer/n_head/n_embd"
+            )
+        out = self
+        if type_given:
+            if self.model_type not in MODEL_PRESETS:
+                raise ConfigError(
+                    f"unknown model_type {self.model_type!r}; "
+                    f"presets: {sorted(MODEL_PRESETS)}"
+                )
+            out = dataclasses.replace(self, **MODEL_PRESETS[self.model_type])
+        out.validate()
+        return out
+
+    def validate(self) -> None:
+        if self.n_embd is None or self.n_head is None or self.n_layer is None:
+            raise ConfigError("model dims unresolved; call .resolved() first")
+        if self.n_embd % self.n_head != 0:
+            raise ConfigError(
+                f"n_embd={self.n_embd} not divisible by n_head={self.n_head}"
+            )
+        kv = self.n_kv_head if self.n_kv_head is not None else self.n_head
+        if self.n_head % kv != 0:
+            raise ConfigError(
+                f"n_head={self.n_head} not divisible by n_kv_head={kv}"
+            )
+        if self.attention not in ("einsum", "flash", "ring"):
+            raise ConfigError(f"unknown attention impl {self.attention!r}")
+        if self.rope and (self.n_embd // self.n_head) % 2 != 0:
+            raise ConfigError(
+                f"rope needs an even head_dim, got {self.n_embd // self.n_head}"
+            )
+        if self.block_size <= 0 or self.vocab_size <= 0:
+            raise ConfigError("block_size and vocab_size must be positive")
+
+    @property
+    def head_dim(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_head if self.n_kv_head is not None else self.n_head
+
+
+@dataclass
+class OptimizerConfig:
+    """Reference OptimizerConfig (model.py:54-59): GPT-3 AdamW values."""
+
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.1
+    betas: Tuple[float, float] = (0.9, 0.95)
+    eps: float = 1e-8
+    # --- extensions: the LR schedule lore the reference README records
+    # (warmup + cosine, README.md:93,125) but the reference never implements.
+    schedule: str = "constant"  # "constant" | "cosine"
+    warmup_steps: int = 0
+    total_steps: Optional[int] = None  # required for cosine
+    min_lr_ratio: float = 0.1
+
+    def __post_init__(self) -> None:
+        if isinstance(self.betas, list):
+            self.betas = tuple(self.betas)  # YAML gives lists
+
+    @classmethod
+    def make(cls, **kwargs: Any) -> "OptimizerConfig":
+        return cls(**_reject_unknown(cls, kwargs))
+
+
+@dataclass
+class DataConfig:
+    """Reference DataConfig (char_dataset.py:12-17)."""
+
+    path: str = ""
+    block_size: int = 128
+    train_split: float = 0.9
+    truncate: float = 1.0
+
+    @classmethod
+    def make(cls, **kwargs: Any) -> "DataConfig":
+        cfg = cls(**_reject_unknown(cls, kwargs))
+        if not (0.0 < cfg.train_split <= 1.0):
+            raise ConfigError(f"train_split={cfg.train_split} outside (0, 1]")
+        if not (0.0 < cfg.truncate <= 1.0):
+            raise ConfigError(f"truncate={cfg.truncate} outside (0, 1]")
+        return cfg
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh shape for pjit/shard_map parallelism.
+
+    Replaces the reference's implicit "one process per GPU, DDP over all"
+    topology (trainer.py:71, slurm_run.sh:17-23) with an explicit named mesh:
+    ``dp`` (data), ``fsdp`` (param shards), ``tp`` (tensor), ``sp`` (sequence,
+    for ring attention). -1 means "absorb all remaining devices".
+    """
+
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @classmethod
+    def make(cls, **kwargs: Any) -> "MeshConfig":
+        return cls(**_reject_unknown(cls, kwargs))
+
+
+@dataclass
+class TrainerConfig:
+    """Reference GPTTrainerConfig (trainer.py:21-29) + TPU extensions."""
+
+    max_epochs: int = 10
+    batch_size: int = 64  # global batch, split across the dp axis
+    grad_norm_clip: float = 1.0
+    snapshot_path: Optional[str] = None
+    save_every: int = 1  # epochs between snapshots
+    # kept for schema parity with the reference (unused there too —
+    # the optimizer owns the LR); warn-level ignored.
+    learning_rate: Optional[float] = None
+    dl_num_workers: int = 0
+    # --- extensions ------------------------------------------------------
+    seed: int = 0
+    log_every: int = 100          # steps between metric lines (reference: 100)
+    eval_every: int = 1           # epochs between eval passes
+    eval_batches: Optional[int] = None  # cap eval batches; None = full pass
+    metrics_jsonl: Optional[str] = None  # JSONL metrics sink (§5.5 upgrade)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    profile_dir: Optional[str] = None   # jax.profiler trace output
+    profile_steps: Tuple[int, int] = (10, 20)
+    max_steps: Optional[int] = None     # step cap (for benches/smoke runs)
+
+    @classmethod
+    def make(cls, **kwargs: Any) -> "TrainerConfig":
+        kwargs = dict(kwargs)
+        mesh = kwargs.pop("mesh", None)
+        cfg = cls(**_reject_unknown(cls, {**kwargs}))
+        if mesh is not None:
+            cfg.mesh = mesh if isinstance(mesh, MeshConfig) else MeshConfig.make(**mesh)
+        if isinstance(cfg.profile_steps, list):
+            cfg.profile_steps = tuple(cfg.profile_steps)
+        return cfg
+
+
+@dataclass
+class ExperimentConfig:
+    """The four-section bundle the reference unpacks at train.py:36-39."""
+
+    gpt_config: GPTConfig
+    optimizer_config: OptimizerConfig
+    data_config: DataConfig
+    trainer_config: TrainerConfig
+
+    SECTIONS = {
+        "gpt_config": GPTConfig,
+        "optimizer_config": OptimizerConfig,
+        "data_config": DataConfig,
+        "trainer_config": TrainerConfig,
+    }
+
+    @classmethod
+    def from_dict(cls, raw: Mapping[str, Any]) -> "ExperimentConfig":
+        unknown = set(raw) - set(cls.SECTIONS)
+        if unknown:
+            raise ConfigError(
+                f"unknown config section(s) {sorted(unknown)}; "
+                f"valid: {sorted(cls.SECTIONS)}"
+            )
+        return cls(
+            gpt_config=GPTConfig.make(**dict(raw.get("gpt_config", {}))),
+            optimizer_config=OptimizerConfig.make(
+                **dict(raw.get("optimizer_config", {}))
+            ),
+            data_config=DataConfig.make(**dict(raw.get("data_config", {}))),
+            trainer_config=TrainerConfig.make(
+                **dict(raw.get("trainer_config", {}))
+            ),
+        )
+
+    def to_dict(self) -> dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+# ---------------------------------------------------------------------------
+# YAML + CLI overrides
+# ---------------------------------------------------------------------------
+
+
+def _parse_override_value(text: str) -> Any:
+    """Parse an override value with YAML scalar rules (1 -> int, true -> bool).
+
+    YAML 1.1 quirk: ``1e-3`` (no dot) parses as a string; accept it as a float
+    the way every CLI user expects.
+    """
+    value = yaml.safe_load(io.StringIO(text))
+    if isinstance(value, str):
+        try:
+            return int(value)
+        except ValueError:
+            pass
+        try:
+            return float(value)
+        except ValueError:
+            pass
+    return value
+
+
+def apply_overrides(raw: dict[str, Any], overrides: Sequence[str]) -> dict[str, Any]:
+    """Apply ``section.key=value`` dotted overrides (the Hydra CLI surface).
+
+    ``section.key=value`` sets; ``~section.key`` deletes. Nested keys use
+    further dots (e.g. ``trainer_config.mesh.dp=4``).
+    """
+    out = {k: (dict(v) if isinstance(v, Mapping) else v) for k, v in raw.items()}
+    for ov in overrides:
+        ov = ov.strip()
+        if not ov:
+            continue
+        if ov.startswith("~"):
+            path, value, delete = ov[1:], None, True
+        elif "=" in ov:
+            path, text = ov.split("=", 1)
+            value, delete = _parse_override_value(text), False
+        else:
+            raise ConfigError(f"malformed override {ov!r}; want key=value or ~key")
+        keys = path.split(".")
+        node = out
+        for k in keys[:-1]:
+            nxt = node.get(k)
+            if not isinstance(nxt, dict):
+                nxt = dict(nxt) if isinstance(nxt, Mapping) else {}
+                node[k] = nxt
+            node = nxt
+        if delete:
+            node.pop(keys[-1], None)
+        else:
+            node[keys[-1]] = value
+    return out
+
+
+def load_config(
+    path: Optional[str] = None, overrides: Sequence[str] = ()
+) -> ExperimentConfig:
+    """Load a YAML config file and apply CLI overrides.
+
+    Replaces the reference's @hydra.main + manual dataclass unpacking
+    (train.py:30-39) with the same observable behavior: a four-section YAML,
+    each section validated into its dataclass, any key overridable from the
+    command line as ``section.key=value``.
+    """
+    raw: dict[str, Any] = {}
+    if path is not None:
+        with open(path) as f:
+            loaded = yaml.safe_load(f) or {}
+        if not isinstance(loaded, Mapping):
+            raise ConfigError(f"config file {path} is not a mapping")
+        raw = {k: v for k, v in loaded.items() if k != "hydra"}
+    raw = apply_overrides(raw, overrides)
+    return ExperimentConfig.from_dict(raw)

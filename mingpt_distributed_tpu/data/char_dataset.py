@@ -1,0 +1,197 @@
+"""Character-level dataset over fsspec, TPU-shaped.
+
+Re-design of /root/reference/mingpt/char_dataset.py:12-47 (CharDataset /
+DataConfig) and the rank-sharded loading the reference delegates to
+torch's DataLoader + DistributedSampler (/root/reference/mingpt/trainer.py:73-81):
+
+* constructed from a ``DataConfig`` (the reference's constructor/callsite
+  mismatch is bug B12 — here there is one constructor and it takes the config);
+* reads the whole corpus through ``fsspec`` so ``path`` may be local,
+  ``s3://`` or ``gs://`` (reference reads s3 via fsspec, char_dataset.py:23,
+  gpt2_config.yaml:9), decoded as UTF-8 text so the vocab is characters, not
+  bytes (the reference's binary-mode read silently made it byte-level — B12);
+* ``truncate`` keeps the leading fraction of the corpus — the reference's
+  cheap smoke-run knob (char_dataset.py:25, gpt2_config.yaml:11);
+* contiguous train/test split instead of ``random_split`` over overlapping
+  windows, which leaked train text into test (B13);
+* batching is a numpy gather producing ``(batch, block)`` int32 arrays ready
+  for device_put under a batch sharding — no per-example Python loop, no
+  pin-memory/worker machinery (XLA wants big host arrays, not tensor streams);
+* per-process sharding by ``(process_index, process_count)`` replaces
+  DistributedSampler: each host draws a disjoint slice of every global batch;
+* the iterator exposes/restores its state (epoch, step, RNG seed) so resume
+  is step-granular, not epoch-granular (SURVEY.md §5.3/§5.4 upgrade).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional, Tuple
+
+import fsspec
+import numpy as np
+
+from mingpt_distributed_tpu.config import DataConfig
+
+
+class CharDataset:
+    """A corpus of characters with next-char (x, y) windows of ``block_size``."""
+
+    def __init__(self, config: DataConfig, text: Optional[str] = None):
+        self.config = config
+        if text is None:
+            with fsspec.open(config.path, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        text = text[: int(len(text) * config.truncate)]
+        # np.unique sorts, so ids match sorted(set(text)) — same vocab order
+        # as the reference (char_dataset.py:27-32) — and the encode is a
+        # single vectorised pass instead of a per-char Python loop.
+        chars_arr = np.array(list(text))
+        vocab, inverse = np.unique(chars_arr, return_inverse=True)
+        chars = vocab.tolist()
+        self.stoi = {ch: i for i, ch in enumerate(chars)}
+        self.itos = {i: ch for ch, i in self.stoi.items()}
+        self.vocab_size = len(chars)
+        self.block_size = config.block_size
+        self.data = inverse.astype(np.int32)
+        if len(self.data) <= self.block_size:
+            raise ValueError(
+                f"corpus ({len(self.data)} chars) must exceed block_size "
+                f"({self.block_size})"
+            )
+
+    # -- sizing ----------------------------------------------------------
+    def __len__(self) -> int:
+        # number of (x, y) windows; mirrors reference char_dataset.py:35-36
+        return len(self.data) - self.block_size
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        chunk = self.data[idx : idx + self.block_size + 1]
+        return chunk[:-1].astype(np.int32), chunk[1:].astype(np.int32)
+
+    # -- vocab -----------------------------------------------------------
+    def encode(self, text: str) -> np.ndarray:
+        return np.array([self.stoi[c] for c in text], dtype=np.int32)
+
+    def decode(self, ids) -> str:
+        return "".join(self.itos[int(i)] for i in np.asarray(ids).reshape(-1))
+
+    # -- splitting -------------------------------------------------------
+    def split(self, train_split: Optional[float] = None) -> Tuple["CharView", "CharView"]:
+        """Contiguous train/test split (fixes B13's window leakage).
+
+        The boundary window [cut - block_size, cut + block_size) is excluded
+        from neither side's *text* but windows are constrained to lie fully
+        inside their own segment, so no (x, y) pair spans the cut.
+        """
+        frac = self.config.train_split if train_split is None else train_split
+        cut = int(len(self.data) * frac)
+        train = CharView(self, 0, cut)
+        test = CharView(self, cut, len(self.data))
+        return train, test
+
+
+class CharView:
+    """A contiguous [start, stop) character range of a CharDataset."""
+
+    def __init__(self, parent: CharDataset, start: int, stop: int):
+        self.parent = parent
+        self.start = start
+        self.stop = stop
+        self.block_size = parent.block_size
+        self.vocab_size = parent.vocab_size
+
+    def __len__(self) -> int:
+        return max(0, (self.stop - self.start) - self.block_size)
+
+    def gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """Vectorised (x, y) batch for window start offsets within this view."""
+        starts = np.asarray(indices, dtype=np.int64) + self.start
+        offs = np.arange(self.block_size + 1, dtype=np.int64)
+        chunks = self.parent.data[starts[:, None] + offs[None, :]]
+        return chunks[:, :-1].astype(np.int32), chunks[:, 1:].astype(np.int32)
+
+
+@dataclass
+class IteratorState:
+    """Resumable position of a ShardedBatchIterator (SURVEY §5.4 upgrade:
+    the reference checkpoints nothing about the data stream)."""
+
+    epoch: int = 0
+    step_in_epoch: int = 0
+    seed: int = 0
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch, "step_in_epoch": self.step_in_epoch, "seed": self.seed}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "IteratorState":
+        return cls(**d)
+
+
+class ShardedBatchIterator:
+    """DistributedSampler + DataLoader analogue for SPMD hosts.
+
+    Every process computes the same global permutation (seeded by
+    ``seed + epoch``, the DistributedSampler set_epoch idiom) and takes the
+    slice of each global batch belonging to ``process_index``; the arrays it
+    yields are the *per-host* shard, to be placed on the mesh with a
+    batch-axis sharding. ``global_batch_size`` must divide by process_count.
+    """
+
+    def __init__(
+        self,
+        view: CharView,
+        global_batch_size: int,
+        *,
+        shuffle: bool = True,
+        seed: int = 0,
+        process_index: int = 0,
+        process_count: int = 1,
+        drop_last: bool = True,
+    ):
+        if global_batch_size % process_count != 0:
+            raise ValueError(
+                f"global_batch_size={global_batch_size} not divisible by "
+                f"process_count={process_count}"
+            )
+        if len(view) < global_batch_size:
+            raise ValueError(
+                f"view has {len(view)} windows < global batch {global_batch_size}"
+            )
+        self.view = view
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // process_count
+        self.shuffle = shuffle
+        self.process_index = process_index
+        self.process_count = process_count
+        self.drop_last = drop_last
+        self.state = IteratorState(seed=seed)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return len(self.view) // self.global_batch_size
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.view)
+        if self.shuffle:
+            rng = np.random.default_rng(self.state.seed + epoch)
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def epoch_batches(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        """Yield the remaining batches of the current epoch, then advance the
+        epoch counter. Resuming from a saved state skips already-seen steps by
+        construction (same seed → same permutation)."""
+        order = self._epoch_order(self.state.epoch)
+        lo = self.state.step_in_epoch
+        for step in range(lo, self.steps_per_epoch):
+            base = step * self.global_batch_size
+            shard = slice(
+                base + self.process_index * self.local_batch_size,
+                base + (self.process_index + 1) * self.local_batch_size,
+            )
+            self.state.step_in_epoch = step + 1
+            yield self.view.gather(order[shard])
+        self.state.epoch += 1
+        self.state.step_in_epoch = 0

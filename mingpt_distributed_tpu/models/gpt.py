@@ -1,0 +1,285 @@
+"""GPT as pure functions over a parameter pytree.
+
+TPU-first re-design of the reference model (/root/reference/mingpt/model.py:
+GPTEmbedding :193-231, Block :171-189, MultiHeadSelfAttention :125-168,
+GPT :234-356). The architecture matches the reference's *intent* — pre-LN
+decoder-only transformer, learned token + (zero-init) learned positional
+embeddings, 4x GELU MLP, final LayerNorm, bias-free LM head, N(0, 0.02) init
+with GPT-2 residual-path scaling 0.02/sqrt(2L) — with the reference's latent
+model bugs (B3-B6, B16: broken asserts, pos-embedding indexed by token value,
+MLP activation after both linears, non-masking float causal mask) fixed by
+construction, and the mechanism re-thought for XLA:
+
+* the model is data — a pytree of float32 arrays — and ``forward`` is a pure
+  function, so sharding enters from *outside* via NamedSharding on the pytree
+  (preserving the reference's parallelism-unaware-model layering, SURVEY §1-L2);
+* per-layer parameters are stacked along a leading layer axis and the block
+  is applied with ``lax.scan`` — one block compiled once, not n_layer copies
+  unrolled, and ``jax.checkpoint`` (cfg.remat) slots in per scan step;
+* activations run in cfg.dtype (bfloat16 on the MXU); normalisations, softmax
+  and the loss run in float32;
+* no (T, T) mask buffer per layer: causality is computed inside attention.
+
+Llama-retrofit toggles (rope/swiglu/rmsnorm/GQA — BASELINE config #5) reuse
+the same skeleton.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.ops import attention as attn_ops
+from mingpt_distributed_tpu.ops import layers as L
+
+Params = Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init(key: jax.Array, cfg: GPTConfig) -> Params:
+    """Materialise the parameter pytree.
+
+    Init scheme is the reference's (model.py:298-307, 252-256): weights
+    N(0, 0.02), biases 0, LayerNorm identity, positional embedding zeros
+    (model.py:209-214), residual-path projections N(0, 0.02/sqrt(2L)).
+    Runs fine under jit with out_shardings so huge models can be born sharded.
+    """
+    cfg.validate()
+    d, nl, nh = cfg.n_embd, cfg.n_layer, cfg.n_head
+    hd, kv = cfg.head_dim, cfg.kv_heads
+    ffn = int(cfg.ffn_mult * d)
+    use_bias = not (cfg.swiglu or cfg.rmsnorm)  # GPT-2 mode has biases everywhere
+
+    keys = iter(jax.random.split(key, 32))
+    std = 0.02
+    resid_std = 0.02 / math.sqrt(2 * nl)
+
+    def normal(k, shape, s=std):
+        return jax.random.normal(k, shape, dtype=jnp.float32) * s
+
+    blocks: Params = {
+        "ln1_scale": jnp.ones((nl, d)),
+        "ln2_scale": jnp.ones((nl, d)),
+        "wq": normal(next(keys), (nl, d, nh * hd)),
+        "wk": normal(next(keys), (nl, d, kv * hd)),
+        "wv": normal(next(keys), (nl, d, kv * hd)),
+        "wo": normal(next(keys), (nl, nh * hd, d), resid_std),
+    }
+    if not cfg.rmsnorm:
+        blocks["ln1_bias"] = jnp.zeros((nl, d))
+        blocks["ln2_bias"] = jnp.zeros((nl, d))
+    if use_bias:
+        blocks.update(
+            bq=jnp.zeros((nl, nh * hd)),
+            bk=jnp.zeros((nl, kv * hd)),
+            bv=jnp.zeros((nl, kv * hd)),
+            bo=jnp.zeros((nl, d)),
+        )
+    if cfg.swiglu:
+        blocks.update(
+            w_gate=normal(next(keys), (nl, d, ffn)),
+            w_up=normal(next(keys), (nl, d, ffn)),
+            w_down=normal(next(keys), (nl, ffn, d), resid_std),
+        )
+    else:
+        blocks.update(
+            w_fc=normal(next(keys), (nl, d, ffn)),
+            w_proj=normal(next(keys), (nl, ffn, d), resid_std),
+        )
+        if use_bias:
+            blocks.update(b_fc=jnp.zeros((nl, ffn)), b_proj=jnp.zeros((nl, d)))
+
+    params: Params = {
+        "wte": normal(next(keys), (cfg.vocab_size, d)),
+        "blocks": blocks,
+        "lnf_scale": jnp.ones((d,)),
+    }
+    if not cfg.rope:
+        params["wpe"] = jnp.zeros((cfg.block_size, d))
+    if not cfg.rmsnorm:
+        params["lnf_bias"] = jnp.zeros((d,))
+    if not cfg.tie_weights:
+        params["head"] = normal(next(keys), (d, cfg.vocab_size))
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def _attention_dispatch(cfg: GPTConfig):
+    """Select the attention implementation named by cfg.attention.
+
+    "einsum" is the oracle (ops/attention.py). "flash" is the Pallas
+    blockwise kernel (ops/flash_attention.py). "ring" is driven from the
+    sequence-parallel path in parallel/ring_attention.py, not from inside
+    this per-shard forward.
+    """
+    if cfg.attention == "einsum":
+        return attn_ops.causal_attention
+    if cfg.attention == "flash":
+        try:
+            from mingpt_distributed_tpu.ops import flash_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                f"flash attention kernel unavailable: {e}"
+            ) from None
+        return flash_attention.causal_attention
+    raise NotImplementedError(
+        f"attention={cfg.attention!r} is not usable from the dense forward; "
+        "use parallel.ring_attention for sequence-parallel execution"
+    )
+
+
+def _norm(x, scale, bias, cfg: GPTConfig):
+    if cfg.rmsnorm:
+        return L.rms_norm(x, scale)
+    return L.layer_norm(x, scale, bias)
+
+
+def _block(
+    x: jax.Array,
+    blk: Params,
+    cfg: GPTConfig,
+    rope: Optional[Tuple[jax.Array, jax.Array]],
+    drop_key: Optional[jax.Array],
+    deterministic: bool,
+) -> jax.Array:
+    """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+    b, t, d = x.shape
+    nh, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    if drop_key is not None:
+        k_attn, k_resid1, k_resid2 = jax.random.split(drop_key, 3)
+    else:
+        k_attn = k_resid1 = k_resid2 = None
+
+    h = _norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg)
+    q = L.dense(h, blk["wq"], blk.get("bq")).reshape(b, t, nh, hd)
+    k = L.dense(h, blk["wk"], blk.get("bk")).reshape(b, t, kv, hd)
+    v = L.dense(h, blk["wv"], blk.get("bv")).reshape(b, t, kv, hd)
+    if rope is not None:
+        cos, sin = rope
+        q = attn_ops.apply_rope(q, cos, sin)
+        k = attn_ops.apply_rope(k, cos, sin)
+    att = _attention_dispatch(cfg)(
+        q, k, v,
+        attn_pdrop=cfg.attn_pdrop,
+        dropout_key=k_attn,
+        deterministic=deterministic,
+    ).reshape(b, t, nh * hd)
+    att = L.dense(att, blk["wo"], blk.get("bo"))
+    att = L.dropout(att, cfg.resid_pdrop, k_resid1, deterministic)
+    x = x + att
+
+    h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg)
+    if cfg.swiglu:
+        m = L.mlp_swiglu(h2, blk["w_gate"], blk["w_up"], blk["w_down"])
+    else:
+        m = L.mlp_gelu(h2, blk["w_fc"], blk.get("b_fc"), blk["w_proj"], blk.get("b_proj"))
+    m = L.dropout(m, cfg.resid_pdrop, k_resid2, deterministic)
+    return x + m
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # (B, T) int32
+    cfg: GPTConfig,
+    *,
+    targets: Optional[jax.Array] = None,  # (B, T) int32, -1 = ignore
+    rng: Optional[jax.Array] = None,
+    deterministic: bool = True,
+) -> Tuple[jax.Array, Optional[jax.Array]]:
+    """Full forward pass -> (logits (B, T, V) float32, loss or None).
+
+    Same contract as the reference's GPT.forward (model.py:309-320): returns
+    logits always, plus mean cross-entropy over targets != -1 when targets
+    are given.
+    """
+    b, t = tokens.shape
+    if t > cfg.block_size:  # static shape — checked at trace time (B3 intent)
+        raise ValueError(f"sequence length {t} > block_size {cfg.block_size}")
+    if not deterministic and rng is None:
+        raise ValueError("training-mode forward needs rng for dropout")
+
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x = params["wte"][tokens]  # (B, T, D) fp32 gather
+    if not cfg.rope:
+        # slice by *position*, add (the B4 fix: reference indexed pos table
+        # by token values and called a Parameter)
+        x = x + params["wpe"][:t]
+    if deterministic:
+        emb_key = None
+    else:
+        rng, emb_key = jax.random.split(rng)
+    x = L.dropout(x, cfg.embd_pdrop, emb_key, deterministic)
+    x = x.astype(compute_dtype)
+
+    rope = None
+    if cfg.rope:
+        rope = attn_ops.rope_tables(jnp.arange(t), cfg.head_dim, cfg.rope_theta)
+
+    nl = cfg.n_layer
+    if deterministic:
+        layer_keys = None
+        def body(carry, blk):
+            return _block(carry, blk, cfg, rope, None, True), None
+        xs = params["blocks"]
+    else:
+        layer_keys = jax.random.split(rng, nl)
+        def body(carry, scanned):
+            blk, key = scanned
+            return _block(carry, blk, cfg, rope, key, False), None
+        xs = (params["blocks"], layer_keys)
+
+    step = jax.checkpoint(body) if cfg.remat else body
+    x, _ = jax.lax.scan(step, x, xs)
+
+    x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)
+    w_head = params["wte"].T if cfg.tie_weights else params["head"]
+    logits = jnp.einsum(
+        "btd,dv->btv", x, w_head.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+
+    loss = None
+    if targets is not None:
+        loss = cross_entropy(logits, targets)
+    return logits, loss
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean CE over positions with target != -1 (reference model.py:316-319:
+    F.cross_entropy(..., ignore_index=-1))."""
+    valid = targets != -1
+    safe = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+
+# ---------------------------------------------------------------------------
+# Reporting (reference C10: print_model_size, model.py:21-33, 257-259)
+# ---------------------------------------------------------------------------
+
+
+def param_count(params: Params) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))
+
+
+def model_size_report(params: Params, cfg: GPTConfig) -> str:
+    n = param_count(params)
+    mb = sum(int(p.size) * p.dtype.itemsize for p in jax.tree.leaves(params)) / 2**20
+    return (
+        f"GPT: {cfg.n_layer}L/{cfg.n_head}H/{cfg.n_embd}d, "
+        f"block {cfg.block_size}, vocab {cfg.vocab_size} — "
+        f"{n/1e6:.2f}M params, {mb:.1f} MB (fp32 master)"
+    )

@@ -1,0 +1,181 @@
+"""Device mesh + sharding rules — the framework's distributed backbone.
+
+Replaces the reference's distributed runtime (SURVEY §1-L1/§2.3): where the
+reference wraps the model in DDP over NCCL (/root/reference/mingpt/trainer.py:71,
+train.py:34) and shards data with DistributedSampler (trainer.py:80), here a
+named ``jax.sharding.Mesh`` over all addressable devices carries every
+parallelism axis, and XLA compiles the collectives (psum over ICI within a
+slice, DCN across hosts) directly into the training step:
+
+  dp    pure data parallelism (the reference's only axis — grad all-reduce)
+  fsdp  data parallelism + ZeRO-style parameter/optimizer sharding
+        (BASELINE config #4: "pjit param sharding, DDP->GSPMD/FSDP analogue")
+  tp    megatron-style tensor parallelism (column/row-split matmuls)
+  sp    sequence/context parallelism for ring attention (long-context axis)
+
+The model stays parallelism-unaware (SURVEY §1-L2's separation, preserved):
+these rules attach NamedShardings to the *pytree* from outside; forward never
+mentions an axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from mingpt_distributed_tpu.config import MeshConfig
+from mingpt_distributed_tpu.utils.pytree import leaf_name
+
+AXES = ("dp", "fsdp", "tp", "sp")
+# Batch is split over every data-ish axis; dp and fsdp both shard the batch,
+# sp shards the sequence (ring attention), tp replicates the batch.
+BATCH_AXES = ("dp", "fsdp")
+
+
+def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple[int, int, int, int]:
+    """Resolve -1 entries ("absorb remaining devices") and validate."""
+    dims = [cfg.dp, cfg.fsdp, cfg.tp, cfg.sp]
+    if dims.count(-1) > 1:
+        raise ValueError(f"at most one mesh axis may be -1, got {dims}")
+    known = math.prod(d for d in dims if d != -1)
+    if -1 in dims:
+        if n_devices % known != 0:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fixed axes product {known}"
+            )
+        dims[dims.index(-1)] = n_devices // known
+    if math.prod(dims) != n_devices:
+        raise ValueError(
+            f"mesh {dict(zip(AXES, dims))} needs {math.prod(dims)} devices, "
+            f"have {n_devices}"
+        )
+    return tuple(dims)
+
+
+def make_mesh(
+    cfg: Optional[MeshConfig] = None, devices: Optional[Sequence[jax.Device]] = None
+) -> Mesh:
+    """Build the named mesh. Device order: jax.devices() is already laid out
+    so that neighbouring ids are ICI neighbours on TPU; inner mesh axes (tp,
+    sp) get the fastest-varying dimension so tensor/sequence collectives ride
+    ICI while dp/fsdp cross slices (SURVEY §2.3's ICI/DCN mapping)."""
+    cfg = cfg or MeshConfig()
+    devs = list(devices) if devices is not None else jax.devices()
+    shape = resolve_mesh_shape(cfg, len(devs))
+    arr = np.array(devs).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def batch_spec() -> P:
+    """(batch, seq) inputs: batch over dp+fsdp, seq over sp."""
+    return P(BATCH_AXES, "sp")
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, batch_spec())
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+# ---------------------------------------------------------------------------
+# Parameter sharding rules
+# ---------------------------------------------------------------------------
+
+# name -> PartitionSpec over the *parameter pytree* produced by models/gpt.py.
+# Block params carry a leading layer axis (scanned), never sharded.
+# Convention (scaling-book megatron recipe):
+#   column-parallel (d_model -> wide): input dim fsdp, output dim tp
+#   row-parallel   (wide -> d_model): input dim tp,   output dim fsdp
+# so a block's tp collectives are one all-gather + one reduce-scatter pair,
+# and fsdp gathers params just-in-time per layer (ZeRO-3 analogue via GSPMD).
+PARAM_RULES: dict[str, P] = {
+    "wte": P("fsdp", "tp"),
+    "wpe": P(None, None),
+    "head": P("tp", "fsdp"),
+    "lnf_scale": P(None),
+    "lnf_bias": P(None),
+    # blocks (leading layer axis)
+    "wq": P(None, "fsdp", "tp"),
+    "wk": P(None, "fsdp", "tp"),
+    "wv": P(None, "fsdp", "tp"),
+    "wo": P(None, "tp", "fsdp"),
+    "w_fc": P(None, "fsdp", "tp"),
+    "w_gate": P(None, "fsdp", "tp"),
+    "w_up": P(None, "fsdp", "tp"),
+    "w_proj": P(None, "tp", "fsdp"),
+    "w_down": P(None, "tp", "fsdp"),
+    "bq": P(None, "tp"),
+    "bk": P(None, "tp"),
+    "bv": P(None, "tp"),
+    "bo": P(None, None),
+    "b_fc": P(None, "tp"),
+    "b_proj": P(None, None),
+    "ln1_scale": P(None, None),
+    "ln1_bias": P(None, None),
+    "ln2_scale": P(None, None),
+    "ln2_bias": P(None, None),
+}
+
+
+def _spec_for(path, leaf) -> P:
+    name = leaf_name(path)
+    try:
+        return PARAM_RULES[name]
+    except KeyError:
+        raise ValueError(
+            f"no sharding rule for parameter {jax.tree_util.keystr(path)!r}"
+        ) from None
+
+
+def param_specs(params_shape: Any) -> Any:
+    """PartitionSpec pytree for a (possibly abstract) parameter pytree."""
+    return jax.tree_util.tree_map_with_path(_spec_for, params_shape)
+
+
+def shard_by_rule(mesh: Mesh, shape: Sequence[int], spec: P) -> NamedSharding:
+    """NamedSharding for one array, downgrading (replicating) any spec axis
+    whose mesh extent doesn't divide the dimension — tiny models on big
+    meshes shard what they can instead of failing."""
+    fixed = []
+    for size, axes in zip(shape, spec):
+        if axes is None:
+            fixed.append(None)
+            continue
+        ax_tuple = axes if isinstance(axes, tuple) else (axes,)
+        n = math.prod(mesh.shape[a] for a in ax_tuple)
+        fixed.append(axes if size % n == 0 else None)
+    return NamedSharding(mesh, P(*fixed))
+
+
+def param_shardings(mesh: Mesh, params_shape: Any) -> Any:
+    """NamedSharding pytree for model params (divisibility-validated)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: shard_by_rule(mesh, leaf.shape, _spec_for(path, leaf)),
+        params_shape,
+    )
+
+
+def state_shardings(mesh: Mesh, state_shape: Any) -> Any:
+    """NamedShardings for a whole TrainState-like pytree.
+
+    Optimizer moments (mu/nu) mirror the params pytree leaf-for-leaf with the
+    same leaf names, so PARAM_RULES applies to them unchanged — ZeRO-style
+    sharded optimizer state for free (BASELINE config #4). Scalars and
+    unrecognised leaves replicate.
+    """
+
+    def rule(path, leaf):
+        if getattr(leaf, "ndim", 0) == 0:
+            return NamedSharding(mesh, P())
+        name = leaf_name(path)
+        if name in PARAM_RULES:
+            return shard_by_rule(mesh, leaf.shape, PARAM_RULES[name])
+        return NamedSharding(mesh, P())
+
+    return jax.tree_util.tree_map_with_path(rule, state_shape)

@@ -1,0 +1,164 @@
+"""Checkpoint / resume: step-granular snapshots to local disk, S3 or GCS.
+
+Re-design of the reference's snapshot machinery (SURVEY §3.4/§5.4):
+ModelSnapshot (/root/reference/mingpt/trainer.py:33-37), torch.save to disk or
+BytesIO->boto3 S3 (trainer.py:83-95,149-167), fsspec read + try-load-else-fresh
+(trainer.py:97-116). Kept: the same public semantics — a single snapshot path
+(any fsspec URL: local, ``s3://``, ``gs://``), "missing snapshot = train from
+scratch", the wrapper-agnostic schema. Fixed / upgraded:
+
+* **single writer** — only process 0 writes (the reference gated on
+  *local* rank 0, so every node raced on one S3 key — bug B9);
+* **step-granular resume** — snapshot carries step, epoch, PRNG key and the
+  data-iterator state, not just an epoch counter (the reference loses
+  mid-epoch progress, sampler position and RNG — SURVEY §5.4 "not saved");
+* **no pickle** — arrays go through flax.serialization msgpack (the
+  reference's torch.load of an untrusted path executes pickle);
+* atomic local writes (tmp + rename) so a killed job can't leave a torn
+  snapshot behind.
+
+The on-disk schema is the public contract (ModelSnapshot analogue):
+``{version, step, epoch, prng, data_state, config, state: {params, opt_state}}``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import fsspec
+import jax
+import numpy as np
+from flax import serialization
+
+SNAPSHOT_VERSION = 1
+DEFAULT_SNAPSHOT_PATH = "gpt_snapshot.msgpack"  # reference default: gpt_snapshot.pt
+
+
+@dataclass
+class Snapshot:
+    """In-memory snapshot (the reference's ModelSnapshot, trainer.py:33-37,
+    extended to step granularity)."""
+
+    params: Any
+    opt_state: Any
+    step: int = 0
+    epoch: int = 0
+    prng: Optional[np.ndarray] = None
+    data_state: dict = field(default_factory=dict)
+    config: dict = field(default_factory=dict)
+
+
+def _to_host(tree: Any) -> Any:
+    """Fully-addressable host copy of a (possibly sharded) pytree."""
+    return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+
+
+def save_snapshot(path: str, snap: Snapshot) -> None:
+    """Serialise and write. Call only from the single writer (process 0)."""
+    payload = {
+        "version": SNAPSHOT_VERSION,
+        "step": snap.step,
+        "epoch": snap.epoch,
+        "prng": None if snap.prng is None else np.asarray(snap.prng),
+        "data_state": json.dumps(snap.data_state),
+        "config": json.dumps(snap.config),
+        "state": {
+            "params": _to_host(snap.params),
+            "opt_state": _to_host(snap.opt_state),
+        },
+    }
+    blob = serialization.to_bytes(payload)
+    if "://" in path:
+        # object stores (s3://, gs://) — fsspec transport, the reference's
+        # boto3 upload path (trainer.py:93-95) generalised
+        with fsspec.open(path, "wb") as f:
+            f.write(blob)
+    else:
+        # local: atomic tmp+rename so resume never sees a torn file
+        tmp = path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+
+
+def load_snapshot(
+    path: str, params_like: Any, opt_state_like: Any
+) -> Optional[Snapshot]:
+    """Try to load; None = no snapshot, train from scratch (the reference's
+    FileNotFoundError branch, trainer.py:103-107).
+
+    ``params_like`` / ``opt_state_like`` supply the target pytree structure
+    (fresh init) the serialised arrays are poured into — shape/dtype mismatch
+    raises rather than silently mistraining.
+    """
+    try:
+        with fsspec.open(path, "rb") as f:
+            blob = f.read()
+    except FileNotFoundError:
+        # only a *missing* snapshot means fresh start; transient I/O or
+        # permission errors must propagate, or a later save would overwrite
+        # a good snapshot with fresh-init state
+        return None
+    target = {
+        "version": 0,
+        "step": 0,
+        "epoch": 0,
+        "prng": np.zeros((), dtype=np.uint32),
+        "data_state": "",
+        "config": "",
+        "state": {
+            "params": _abstract_to_zeros(params_like),
+            "opt_state": _abstract_to_zeros(opt_state_like),
+        },
+    }
+    payload = serialization.from_bytes(target, blob)
+    if payload["version"] != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"snapshot version {payload['version']} != {SNAPSHOT_VERSION}"
+        )
+    _check_shapes(params_like, payload["state"]["params"], "params")
+    _check_shapes(opt_state_like, payload["state"]["opt_state"], "opt_state")
+    return Snapshot(
+        params=payload["state"]["params"],
+        opt_state=payload["state"]["opt_state"],
+        step=int(payload["step"]),
+        epoch=int(payload["epoch"]),
+        prng=payload["prng"],
+        data_state=json.loads(payload["data_state"]) if payload["data_state"] else {},
+        config=json.loads(payload["config"]) if payload["config"] else {},
+    )
+
+
+def _check_shapes(expected: Any, restored: Any, label: str) -> None:
+    """Refuse shape/dtype drift between the current config's state and the
+    snapshot — e.g. a vocab change with a stale snapshot_path would otherwise
+    silently mistrain (flax from_bytes does not validate leaf shapes)."""
+
+    def check(path, exp, got):
+        eshape = tuple(getattr(exp, "shape", ()) or ())
+        gshape = tuple(np.shape(got))
+        if eshape != gshape:
+            raise ValueError(
+                f"snapshot {label} leaf {jax.tree_util.keystr(path)} has "
+                f"shape {gshape}, but the current config expects {eshape} — "
+                f"refusing to restore (did the dataset/model config change "
+                f"under an old snapshot_path?)"
+            )
+
+    jax.tree_util.tree_map_with_path(check, expected, restored)
+
+
+def _abstract_to_zeros(tree: Any) -> Any:
+    """Accept concrete arrays or ShapeDtypeStructs as the target skeleton."""
+
+    def conv(x):
+        if isinstance(x, jax.ShapeDtypeStruct):
+            return np.zeros(x.shape, x.dtype)
+        return x
+
+    return jax.tree.map(conv, tree)

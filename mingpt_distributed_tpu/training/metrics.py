@@ -1,0 +1,105 @@
+"""Metrics / observability (SURVEY §5.5 upgrade).
+
+The reference logs an unreduced per-rank loss via print every 100 batches
+(/root/reference/mingpt/trainer.py:144-147) and nothing else; its README
+self-deprecates the approach (README.md:74). Here: structured per-step
+metrics — loss (already a global mean: the batch axis spans the whole mesh),
+grad norm, LR, tokens/sec/chip and MFU from the 6ND flop model — emitted from
+process 0 only, to stdout and optionally a JSONL file (pluggable sink).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional, TextIO
+
+import jax
+
+from mingpt_distributed_tpu.config import GPTConfig
+
+# Peak dense bf16 FLOP/s per chip, for MFU. Public numbers.
+PEAK_FLOPS: dict[str, float] = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,  # v5e
+    "TPU v5e": 197e12,
+    "TPU v5": 459e12,  # v5p
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,  # v6e (Trillium)
+}
+
+
+def peak_flops_per_chip() -> Optional[float]:
+    kind = jax.devices()[0].device_kind
+    for name, val in PEAK_FLOPS.items():
+        if kind.startswith(name):
+            return val
+    return None
+
+
+def flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
+    """Training FLOPs/token: 6*N_matmul + attention term 12*L*d*T
+    (the 6ND model with the quadratic-attention correction)."""
+    t = seq_len or cfg.block_size
+    d, l, v = cfg.n_embd, cfg.n_layer, cfg.vocab_size
+    ffn = int(cfg.ffn_mult * d)
+    kv = cfg.kv_heads * cfg.head_dim
+    per_layer = d * (d + 2 * kv) + d * d  # qkv + out proj
+    per_layer += (3 if cfg.swiglu else 2) * d * ffn
+    n_matmul = l * per_layer + d * v  # + lm head (embeddings are gathers)
+    attn = 12 * l * d * t  # 2 score+value matmuls, fwd+bwd (6x), * d * T
+    return 6 * n_matmul + attn
+
+
+class MetricsLogger:
+    """stdout + optional JSONL sink; rate/MFU computed over log windows."""
+
+    def __init__(
+        self,
+        cfg: GPTConfig,
+        *,
+        jsonl_path: Optional[str] = None,
+        n_chips: int = 1,
+        enabled: bool = True,
+    ):
+        self.cfg = cfg
+        self.n_chips = max(n_chips, 1)
+        self.enabled = enabled
+        self._jsonl: Optional[TextIO] = None
+        if enabled and jsonl_path:
+            self._jsonl = open(jsonl_path, "a")
+        self._last_time: Optional[float] = None
+        self._last_step: Optional[int] = None
+        self._peak = peak_flops_per_chip()
+
+    def log_step(
+        self, step: int, tokens_per_step: int, seq_len: int, scalars: Dict[str, Any]
+    ) -> Dict[str, Any]:
+        now = time.perf_counter()
+        rec: Dict[str, Any] = {"step": step}
+        rec.update({k: float(v) for k, v in scalars.items()})
+        if self._last_time is not None and step > self._last_step:
+            dt = now - self._last_time
+            steps = step - self._last_step
+            tps = tokens_per_step * steps / dt
+            rec["tokens_per_sec"] = tps
+            rec["tokens_per_sec_per_chip"] = tps / self.n_chips
+            flops = flops_per_token(self.cfg, seq_len) * tps / self.n_chips
+            rec["flops_per_chip"] = flops
+            if self._peak:
+                rec["mfu"] = flops / self._peak
+        self._last_time, self._last_step = now, step
+        if self.enabled:
+            parts = [f"step {step}"] + [
+                f"{k} {v:.4g}" for k, v in rec.items() if k != "step"
+            ]
+            print(" | ".join(parts), flush=True)
+            if self._jsonl:
+                self._jsonl.write(json.dumps(rec) + "\n")
+                self._jsonl.flush()
+        return rec
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None

@@ -1,0 +1,15 @@
+"""Small pytree helpers shared across the package."""
+
+from __future__ import annotations
+
+import jax
+
+
+def leaf_name(path) -> str:
+    """Final key of a tree_map_with_path path — the parameter's name.
+
+    Works for dict keys (DictKey), dataclass/namedtuple fields (GetAttrKey)
+    and sequence indices (SequenceKey).
+    """
+    last = path[-1]
+    return getattr(last, "key", getattr(last, "name", str(last)))

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Run the test suite on an 8-device virtual CPU mesh (SURVEY.md §4).
+#
+# PYTHONPATH/PALLAS_AXON_POOL_IPS are cleared so any TPU-plugin
+# sitecustomize hook in the ambient environment doesn't dial real hardware
+# from every test process; JAX_PLATFORMS=cpu + forced host device count give
+# the same pjit/shard_map semantics as an 8-chip slice.
+set -euo pipefail
+cd "$(dirname "$0")"
+exec env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m pytest tests/ "$@"

@@ -1,0 +1,32 @@
+"""Packaging (reference parity: /root/reference/setup.py:1-11).
+
+The reference packages `mingpt` 0.0.1 requiring torch+hydra-core; here the
+package is the TPU-native framework and the deps are the JAX stack (all baked
+into the target image — keep install_requires minimal and pin-free).
+"""
+
+from setuptools import find_packages, setup
+
+setup(
+    name="mingpt-distributed-tpu",
+    version="0.1.0",
+    description=(
+        "A TPU-native (JAX/XLA/Pallas/pjit) re-implementation of GPT trained "
+        "on multiple hosts — capabilities of minGPT-distributed, rebuilt "
+        "TPU-first"
+    ),
+    packages=find_packages(include=["mingpt_distributed_tpu*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "optax",
+        "pyyaml",
+        "numpy",
+        "fsspec",
+    ],
+    extras_require={
+        "s3": ["boto3", "s3fs"],
+        "gcs": ["gcsfs"],
+        "test": ["pytest"],
+    },
+)

@@ -1,0 +1,32 @@
+"""Test harness: run everything on an 8-device virtual CPU mesh.
+
+The reference had no way to exercise its distributed path without a real
+cluster (SURVEY.md §4, §5.8 — NCCL hard-coded at train.py:34). Here the same
+pjit/shard_map code runs on 8 fake CPU devices, so data-parallel ==
+single-device equivalence, sharding, and ring attention are all CI-testable.
+"""
+
+import os
+
+# Force CPU before jax initialises its backends: tests must be hermetic and
+# fast even on a machine whose env pins JAX_PLATFORMS to a TPU plugin.
+# (Prefer ./run_tests.sh, which also strips TPU-plugin sitecustomize hooks.)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs[:8]

@@ -1,0 +1,122 @@
+"""Config layer tests — including regression tests for the reference's config
+bugs (SURVEY.md §2.9 B1/B2/B15), which the new design must make impossible."""
+
+import pytest
+
+from mingpt_distributed_tpu.config import (
+    ConfigError,
+    ExperimentConfig,
+    GPTConfig,
+    MODEL_PRESETS,
+    OptimizerConfig,
+    apply_overrides,
+    load_config,
+)
+
+
+def test_preset_fills_dims():
+    cfg = GPTConfig.make(model_type="gpt2")
+    assert (cfg.n_layer, cfg.n_head, cfg.n_embd) == (12, 12, 768)
+    assert cfg.vocab_size == 50257 and cfg.block_size == 1024
+
+
+def test_explicit_dims():
+    cfg = GPTConfig.make(n_layer=8, n_head=8, n_embd=512)
+    assert cfg.head_dim == 64
+
+
+def test_preset_xor_explicit_is_enforced():
+    # B1 regression: the reference let presets clobber explicit dims.
+    with pytest.raises(ConfigError):
+        GPTConfig.make(model_type="gpt2", n_layer=8, n_head=8, n_embd=512)
+    with pytest.raises(ConfigError):
+        GPTConfig.make()  # neither given
+
+
+def test_n_embed_alias_normalised():
+    # B2/B15 regression: both spellings resolve to the canonical n_embd.
+    cfg = GPTConfig.make(n_layer=2, n_head=2, n_embed=64)
+    assert cfg.n_embd == 64
+
+
+def test_unknown_key_rejected():
+    with pytest.raises(ConfigError, match="unknown key"):
+        GPTConfig.make(model_type="gpt2", n_heads=12)
+
+
+def test_all_presets_resolve():
+    for name in MODEL_PRESETS:
+        cfg = GPTConfig.make(model_type=name)
+        assert cfg.n_embd % cfg.n_head == 0
+
+
+def test_divisibility_checked():
+    with pytest.raises(ConfigError, match="divisible"):
+        GPTConfig.make(n_layer=2, n_head=7, n_embd=64)
+
+
+def test_betas_tuple_from_yaml_list():
+    cfg = OptimizerConfig.make(betas=[0.9, 0.98])
+    assert cfg.betas == (0.9, 0.98)
+
+
+def test_overrides_dotted_and_typed():
+    raw = {"gpt_config": {"model_type": "gpt-nano"}}
+    out = apply_overrides(
+        raw,
+        [
+            "gpt_config.block_size=256",
+            "trainer_config.mesh.dp=4",
+            "optimizer_config.learning_rate=1e-3",
+            "gpt_config.remat=true",
+        ],
+    )
+    cfg = ExperimentConfig.from_dict(out)
+    assert cfg.gpt_config.block_size == 256
+    assert cfg.trainer_config.mesh.dp == 4
+    assert cfg.optimizer_config.learning_rate == pytest.approx(1e-3)
+    assert cfg.gpt_config.remat is True
+
+
+def test_override_delete():
+    raw = {"gpt_config": {"model_type": "gpt-nano", "block_size": 64}}
+    out = apply_overrides(raw, ["~gpt_config.block_size"])
+    assert "block_size" not in out["gpt_config"]
+
+
+def test_load_yaml_roundtrip(tmp_path):
+    p = tmp_path / "cfg.yaml"
+    p.write_text(
+        """
+gpt_config:
+  n_layer: 8
+  n_head: 8
+  n_embd: 512
+  block_size: 128
+optimizer_config:
+  learning_rate: 3.0e-4
+  weight_decay: 0.1
+data_config:
+  path: /tmp/input.txt
+  block_size: 128
+  truncate: 0.05
+trainer_config:
+  max_epochs: 10
+  batch_size: 64
+  save_every: 3
+"""
+    )
+    cfg = load_config(str(p), overrides=["trainer_config.max_epochs=2"])
+    assert cfg.gpt_config.n_embd == 512
+    assert cfg.trainer_config.max_epochs == 2
+    assert cfg.data_config.truncate == 0.05
+
+
+def test_unknown_section_rejected():
+    with pytest.raises(ConfigError, match="section"):
+        ExperimentConfig.from_dict({"modle_config": {}})
+
+
+def test_rope_requires_even_head_dim():
+    with pytest.raises(ConfigError, match="even head_dim"):
+        GPTConfig.make(n_layer=2, n_head=2, n_embd=6, rope=True)

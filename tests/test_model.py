@@ -1,0 +1,141 @@
+"""Model-core tests — SURVEY §4's "do better" list: causality (the test that
+would have caught B6), loss at init ≈ ln(vocab), shapes, ignore_index, llama
+toggles, remat equivalence."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models import gpt
+
+
+def small_cfg(**kw):
+    base = dict(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=65, block_size=16,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    base.update(kw)
+    return GPTConfig.make(**base)
+
+
+def test_forward_shapes_and_loss_at_init():
+    cfg = small_cfg()
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, cfg.vocab_size)
+    logits, loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    assert logits.shape == (4, 16, 65)
+    assert logits.dtype == jnp.float32
+    # At init the model is ~uniform: CE ≈ ln(vocab_size).
+    assert abs(float(loss) - np.log(65)) < 0.2
+
+
+def test_causality():
+    """Logits at position t must not change when tokens > t change (B6)."""
+    cfg = small_cfg()
+    params = gpt.init(jax.random.key(0), cfg)
+    a = jax.random.randint(jax.random.key(1), (1, 16), 0, 65)
+    b = a.at[:, 10:].set((a[:, 10:] + 7) % 65)  # perturb the future
+    la, _ = gpt.forward(params, a, cfg)
+    lb, _ = gpt.forward(params, b, cfg)
+    np.testing.assert_allclose(la[:, :10], lb[:, :10], rtol=1e-5, atol=1e-5)
+    # and the perturbed tail must actually differ (sanity of the test itself)
+    assert not np.allclose(la[:, 10:], lb[:, 10:], atol=1e-5)
+
+
+def test_ignore_index_masks_loss():
+    cfg = small_cfg()
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 65)
+    targets_full = tokens
+    targets_masked = targets_full.at[:, :8].set(-1)
+    _, loss_full = gpt.forward(params, tokens, cfg, targets=targets_full)
+    _, loss_masked = gpt.forward(params, tokens, cfg, targets=targets_masked)
+    assert not np.isnan(float(loss_masked))
+    assert float(loss_full) != float(loss_masked)
+    # all-masked -> zero loss, no NaN (divide-by-zero guard)
+    _, loss_none = gpt.forward(
+        params, tokens, cfg, targets=jnp.full_like(tokens, -1)
+    )
+    assert float(loss_none) == 0.0
+
+
+def test_dropout_train_vs_eval():
+    cfg = small_cfg(embd_pdrop=0.5, resid_pdrop=0.5, attn_pdrop=0.5)
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 65)
+    l1, _ = gpt.forward(params, tokens, cfg, rng=jax.random.key(2), deterministic=False)
+    l2, _ = gpt.forward(params, tokens, cfg, rng=jax.random.key(3), deterministic=False)
+    le, _ = gpt.forward(params, tokens, cfg)
+    assert not np.allclose(l1, l2)  # different dropout masks
+    le2, _ = gpt.forward(params, tokens, cfg)
+    np.testing.assert_array_equal(le, le2)  # eval is deterministic
+
+
+def test_remat_matches_plain():
+    cfg = small_cfg()
+    cfg_r = small_cfg(remat=True)
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 65)
+
+    def loss_of(c):
+        def f(p):
+            return gpt.forward(p, tokens, c, targets=tokens)[1]
+        return f
+
+    l0, g0 = jax.value_and_grad(loss_of(cfg))(params)
+    l1, g1 = jax.value_and_grad(loss_of(cfg_r))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6), g0, g1
+    )
+
+
+def test_llama_mode_forward_and_causality():
+    cfg = small_cfg(
+        rope=True, swiglu=True, rmsnorm=True, n_kv_head=1, tie_weights=True,
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    assert "wpe" not in params and "head" not in params
+    assert "bq" not in params["blocks"] and "ln1_bias" not in params["blocks"]
+    a = jax.random.randint(jax.random.key(1), (1, 16), 0, 65)
+    b = a.at[:, 12:].set((a[:, 12:] + 3) % 65)
+    la, loss = gpt.forward(params, a, cfg, targets=a)
+    lb, _ = gpt.forward(params, b, cfg)
+    np.testing.assert_allclose(la[:, :12], lb[:, :12], rtol=1e-5, atol=1e-5)
+    # Tied weights correlate head with the input embedding in the residual
+    # stream, so init loss sits a bit *below* ln(V) — just require sane.
+    assert 2.0 < float(loss) < np.log(65) + 0.3
+
+
+def test_seq_longer_than_block_rejected():
+    cfg = small_cfg()
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jnp.zeros((1, 32), dtype=jnp.int32)
+    with pytest.raises(ValueError, match="block_size"):
+        gpt.forward(params, tokens, cfg)
+
+
+def test_param_count_gpt2_preset():
+    # Shape-only init (eval_shape — no arrays) on the real preset.
+    def count(cfg):
+        shapes = jax.eval_shape(lambda k: gpt.init(k, cfg), jax.random.key(0))
+        return sum(int(np.prod(s.shape)) for s in jax.tree.leaves(shapes))
+
+    # Weight-tied: the canonical "124M" (124,439,808 exactly).
+    assert count(GPTConfig.make(model_type="gpt2", tie_weights=True)) == 124439808
+    # Untied (the reference's separate bias-free head, model.py:249): +V*D.
+    assert count(GPTConfig.make(model_type="gpt2")) == 124439808 + 50257 * 768
+
+
+def test_gradients_flow_everywhere():
+    cfg = small_cfg()
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 65)
+    g = jax.grad(lambda p: gpt.forward(p, tokens, cfg, targets=tokens)[1])(params)
+    zero_leaves = [
+        path for path, leaf in jax.tree_util.tree_leaves_with_path(g)
+        if float(jnp.abs(leaf).max()) == 0.0
+    ]
+    assert not zero_leaves, f"dead params: {zero_leaves}"

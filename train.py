@@ -1,0 +1,84 @@
+#!/usr/bin/env python
+"""Training entry point — the reference's mingpt/train.py re-done TPU-first.
+
+Reference flow (/root/reference/mingpt/train.py:30-58): hydra main -> NCCL
+process group -> unpack 4 config dataclasses -> build dataset/model/optimizer
+(get_resources, train.py:11-27) -> GPTTrainer -> train() -> teardown.
+
+Same flow here, with the TPU-native mechanisms: YAML + dotted CLI overrides
+(no Hydra run-dir games), jax.distributed for multi-host, a named device mesh
+instead of DDP, and vocab/block-size overridden from the dataset exactly as
+the reference does (train.py:23-24 — fixing its b13/b14 import and split bugs).
+
+Usage:
+  python train.py                               # gpt2_config.yaml
+  python train.py --config my.yaml trainer_config.max_epochs=2
+  python train.py gpt_config.model_type=gpt-mini data_config.path=in.txt
+
+Run the SAME command on every TPU worker host (launch/tpu_pod_run.sh does
+this) — process topology comes from the environment, like torchrun's env
+contract (SURVEY §1-L0: launcher-sets-env / app-reads-env, preserved).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+
+import jax
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--config", default="gpt2_config.yaml", help="YAML config file"
+    )
+    parser.add_argument(
+        "overrides", nargs="*", help="dotted overrides: section.key=value"
+    )
+    args = parser.parse_args(argv)
+
+    from mingpt_distributed_tpu.parallel import distributed
+
+    distributed.initialize()  # init_process_group analogue (no-op single host)
+
+    from mingpt_distributed_tpu.config import load_config
+    from mingpt_distributed_tpu.data.char_dataset import CharDataset
+    from mingpt_distributed_tpu.training.trainer import GPTTrainer
+
+    cfg = load_config(args.config, args.overrides)
+
+    # get_resources (reference train.py:11-27): dataset -> split -> override
+    # model vocab/block from the data -> trainer owns model+optimizer configs.
+    dataset = CharDataset(cfg.data_config)
+    train_view, test_view = dataset.split()
+    gpt_cfg = dataclasses.replace(
+        cfg.gpt_config,
+        vocab_size=dataset.vocab_size,
+        block_size=dataset.block_size,
+    )
+    if jax.process_index() == 0:
+        print(
+            f"data: {len(dataset.data)} chars, vocab {dataset.vocab_size}, "
+            f"{len(train_view)} train / {len(test_view)} test windows"
+        )
+
+    trainer = GPTTrainer(
+        cfg.trainer_config,
+        gpt_cfg,
+        cfg.optimizer_config,
+        train_view,
+        test_view,
+        experiment_config=cfg,
+    )
+    try:
+        trainer.train()
+    finally:
+        trainer.metrics.close()
+        distributed.shutdown()  # destroy_process_group analogue
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
