@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Benchmark: GPT-2 124M training-step throughput + MFU on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+
+The reference publishes no numbers (SURVEY §6; BASELINE.json "published": {});
+the driver-set north star is >=80% MFU on GPT-2 124M at seq 1024, so
+``vs_baseline`` reports measured-MFU / 0.80.
+
+The measured program is the full jitted training step (forward + backward +
+AdamW update, donated state) — the same compiled unit the trainer runs, not a
+matmul microbench.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.training.metrics import (
+        flops_per_token,
+        peak_flops_per_chip,
+    )
+    from mingpt_distributed_tpu.training.optimizer import make_optimizer
+    from mingpt_distributed_tpu.training.trainer import make_train_step
+
+    seq = 1024
+    cfg = GPTConfig.make(
+        model_type="gpt2",
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,  # pure-compute bench
+        dtype="bfloat16",
+    )
+    optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
+    step_fn = jax.jit(make_train_step(cfg, optimizer), donate_argnums=(0,))
+
+    def try_batch(batch: int) -> float:
+        """steps/sec for a given per-chip batch, or raise on OOM."""
+        state = jax.jit(
+            lambda k: {
+                "params": gpt.init(k, cfg),
+                "opt_state": optimizer.init(gpt.init(k, cfg)),
+                "step": jnp.asarray(0, dtype=jnp.int32),
+            }
+        )(jax.random.key(0))
+        # opt_state init duplicated gpt.init above only for tracing brevity;
+        # XLA CSEs the two identical inits into one.
+        tokens = jax.random.randint(
+            jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype=jnp.int32
+        )
+        rng = jax.random.key(2)
+        # warmup (compile + 2 steps)
+        for _ in range(3):
+            state, m = step_fn(state, (tokens, tokens), rng)
+        jax.block_until_ready(m)
+        n_steps = 10
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            state, m = step_fn(state, (tokens, tokens), rng)
+        jax.block_until_ready(m)
+        dt = time.perf_counter() - t0
+        return n_steps / dt
+
+    result = None
+    for batch in (16, 8, 4):
+        try:
+            sps = try_batch(batch)
+            result = (batch, sps)
+            break
+        except Exception as e:  # noqa: BLE001 — OOM/backend errors: try smaller
+            msg = str(e)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg.lower():
+                continue
+            raise
+    if result is None:
+        print(json.dumps({"metric": "mfu_gpt2_124m_seq1024", "value": 0.0,
+                          "unit": "fraction", "vs_baseline": 0.0,
+                          "error": "all batch sizes OOM"}))
+        return 1
+
+    batch, steps_per_sec = result
+    tokens_per_sec = steps_per_sec * batch * seq
+    fpt = flops_per_token(cfg, seq)
+    peak = peak_flops_per_chip()
+    achieved = tokens_per_sec * fpt
+    mfu = achieved / peak if peak else None
+
+    dev = jax.devices()[0]
+    record = {
+        "metric": "mfu_gpt2_124m_seq1024",
+        "value": round(mfu, 4) if mfu is not None else None,
+        "unit": "fraction",
+        # north-star target is 0.80 MFU (BASELINE.md) — no reference-published
+        # number exists, so the baseline is the target
+        "vs_baseline": round(mfu / 0.80, 4) if mfu is not None else None,
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "flops_per_token": fpt,
+        "achieved_tflops": round(achieved / 1e12, 2),
+        "peak_tflops": round(peak / 1e12, 1) if peak else None,
+        "batch": batch,
+        "seq": seq,
+        "device": dev.device_kind,
+        "n_devices": jax.device_count(),
+    }
+    print(json.dumps(record))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -71,13 +71,16 @@ def _reject_unknown(cls, kwargs: Mapping[str, Any]) -> dict[str, Any]:
     return dict(kwargs)
 
 
-@dataclass
+@dataclass(frozen=True)
 class GPTConfig:
     """Model hyperparameters (reference GPTConfig, model.py:38-51).
 
     Either give ``model_type`` (a preset name) or the explicit dims
     ``n_layer/n_head/n_embd`` — exactly one of the two (upstream minGPT's
     XOR assert; the reference fork broke this, SURVEY.md B1).
+
+    Frozen (hashable): instances are jit static arguments; evolve with
+    ``dataclasses.replace``.
     """
 
     model_type: Optional[str] = None

@@ -1,0 +1,199 @@
+"""Autoregressive generation — KV-cached, fully compiled.
+
+Same contract as the reference's GPT.generate
+(/root/reference/mingpt/model.py:322-356): greedy or sampled decoding with
+``temperature`` and optional ``top_k``, context bounded by ``block_size``.
+
+The mechanism is deliberately NOT the reference's: the reference re-runs the
+full forward over the whole (cropped) sequence for every new token with a
+growing ``torch.cat`` — O(T·full-forward), shape-changing every step, which
+under jit would recompile per step (SURVEY §3.3 flags this as the idiom not
+to translate). Here decoding is two compiled programs:
+
+  1. **prefill** — one batched forward over the prompt that also writes every
+     layer's K/V into a preallocated ``(L, B, block_size, KV, hd)`` cache;
+  2. **decode** — a single ``lax.scan`` over ``max_new_tokens`` steps, each
+     step one-token attention against the cache (static shapes throughout,
+     cache updated in place via dynamic_update_slice).
+
+Context-window semantics: the prompt is cropped host-side to the last
+``block_size - max_new_tokens`` tokens so prompt+generation fit the cache
+(the reference instead re-crops to the last block_size tokens every step; the
+two coincide whenever generation fits the window, the common case).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models import gpt
+from mingpt_distributed_tpu.ops import attention as attn_ops
+from mingpt_distributed_tpu.ops import layers as L
+
+Cache = Dict[str, jax.Array]  # {"k","v"}: (n_layer, B, block_size, KV, hd)
+
+
+def init_cache(cfg: GPTConfig, batch: int, dtype=None) -> Cache:
+    shape = (cfg.n_layer, batch, cfg.block_size, cfg.kv_heads, cfg.head_dim)
+    dtype = dtype or jnp.dtype(cfg.dtype)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def _cached_block(
+    x: jax.Array,            # (B, T, D) — T = prompt length or 1
+    blk: gpt.Params,         # one layer's params (no leading L axis)
+    cache_kv: Tuple[jax.Array, jax.Array],  # (B, S, KV, hd) each
+    offset: jax.Array,       # scalar: absolute position of x[:, 0]
+    cfg: GPTConfig,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """One pre-LN block reading/writing the KV cache; returns (y, new_kv)."""
+    b, t, _ = x.shape
+    nh, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
+    ck, cv = cache_kv
+
+    h = gpt._norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg)
+    q = L.dense(h, blk["wq"], blk.get("bq")).reshape(b, t, nh, hd)
+    k = L.dense(h, blk["wk"], blk.get("bk")).reshape(b, t, kv, hd)
+    v = L.dense(h, blk["wv"], blk.get("bv")).reshape(b, t, kv, hd)
+    if cfg.rope:
+        cos, sin = attn_ops.rope_tables(
+            offset + jnp.arange(t), hd, cfg.rope_theta
+        )
+        q = attn_ops.apply_rope(q, cos, sin)
+        k = attn_ops.apply_rope(k, cos, sin)
+
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, offset, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, offset, 0, 0))
+    # attend against the whole cache; kv_offset makes query absolute
+    # positions correct, and the causal mask kills both future tokens and
+    # never-written (zero) slots beyond offset+t
+    att = attn_ops.causal_attention(
+        q, ck, cv, kv_offset=offset
+    ).reshape(b, t, nh * hd)
+    att = L.dense(att, blk["wo"], blk.get("bo"))
+    x = x + att
+
+    h2 = gpt._norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg)
+    if cfg.swiglu:
+        m = L.mlp_swiglu(h2, blk["w_gate"], blk["w_up"], blk["w_down"])
+    else:
+        m = L.mlp_gelu(h2, blk["w_fc"], blk.get("b_fc"), blk["w_proj"],
+                       blk.get("b_proj"))
+    return x + m, (ck, cv)
+
+
+def _forward_cached(
+    params: gpt.Params, tokens: jax.Array, cache: Cache, offset, cfg: GPTConfig
+) -> Tuple[jax.Array, Cache]:
+    """Forward (B, T) tokens at absolute position ``offset`` through all
+    layers, reading+writing the cache. Returns (last-position logits, cache)."""
+    b, t = tokens.shape
+    compute_dtype = jnp.dtype(cfg.dtype)
+    x = params["wte"][tokens]
+    if not cfg.rope:
+        pos = offset + jnp.arange(t)
+        x = x + jnp.take(params["wpe"], pos, axis=0)
+    x = x.astype(compute_dtype)
+
+    def body(carry, scanned):
+        xc = carry
+        blk, ck, cv = scanned
+        y, (ck, cv) = _cached_block(xc, blk, (ck, cv), offset, cfg)
+        return y, (ck, cv)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"])
+    )
+    x = gpt._norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)
+    w_head = params["wte"].T if cfg.tie_weights else params["head"]
+    logits = jnp.einsum(
+        "btd,dv->btv", x[:, -1:], w_head.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )[:, 0]
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _select_next(
+    logits: jax.Array, rng, temperature: float, do_sample: bool,
+    top_k: Optional[int],
+) -> jax.Array:
+    """Temperature / top-k / sample-vs-argmax — reference model.py:341-352."""
+    logits = logits / jnp.maximum(temperature, 1e-8)
+    if top_k is not None:
+        k = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if do_sample:
+        return jax.random.categorical(rng, logits, axis=-1)
+    return jnp.argmax(logits, axis=-1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "do_sample", "top_k"),
+)
+def _generate_jit(
+    params, idx, rng, *, cfg: GPTConfig, max_new_tokens: int,
+    temperature: float, do_sample: bool, top_k: Optional[int],
+):
+    b, t0 = idx.shape
+    cache = init_cache(cfg, b)
+    step_keys = jax.random.split(rng, max_new_tokens)
+
+    # prefill the prompt, pick the first new token
+    logits, cache = _forward_cached(params, idx, cache, 0, cfg)
+    first = _select_next(logits, step_keys[0], temperature, do_sample, top_k)
+    if max_new_tokens == 1:  # static
+        return jnp.concatenate([idx, first[:, None]], axis=1)
+
+    def step(carry, step_rng):
+        tok, cache, pos = carry
+        logits, cache = _forward_cached(params, tok[:, None], cache, pos, cfg)
+        nxt = _select_next(logits, step_rng, temperature, do_sample, top_k)
+        return (nxt, cache, pos + 1), tok
+
+    (last, _, _), toks = jax.lax.scan(
+        step, (first, cache, jnp.asarray(t0)), step_keys[1:]
+    )
+    new_tokens = jnp.concatenate(
+        [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1
+    )
+    return jnp.concatenate([idx, new_tokens], axis=1)
+
+
+def generate(
+    params: gpt.Params,
+    cfg: GPTConfig,
+    idx,
+    max_new_tokens: int,
+    temperature: float = 1.0,
+    do_sample: bool = False,
+    top_k: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations of ``idx`` (B, T0).
+
+    Keeps the reference's signature and semantics (model.py:323-328); one
+    compiled program per (prompt_len, max_new_tokens) pair thereafter.
+    """
+    idx = jnp.asarray(idx, dtype=jnp.int32)
+    if idx.ndim == 1:
+        idx = idx[None]
+    if max_new_tokens < 1:
+        return idx
+    # crop so prompt + generation fit the cache (see module docstring)
+    keep = max(1, cfg.block_size - max_new_tokens)
+    if idx.shape[1] > keep:
+        idx = idx[:, -keep:]
+    if rng is None:
+        rng = jax.random.key(0)
+    return _generate_jit(
+        params, idx, rng, cfg=cfg, max_new_tokens=max_new_tokens,
+        temperature=float(temperature), do_sample=bool(do_sample),
+        top_k=None if top_k is None else int(top_k),
+    )

@@ -87,14 +87,16 @@ def save_snapshot(path: str, snap: Snapshot) -> None:
 
 
 def load_snapshot(
-    path: str, params_like: Any, opt_state_like: Any
+    path: str, params_like: Any, opt_state_like: Any = None
 ) -> Optional[Snapshot]:
     """Try to load; None = no snapshot, train from scratch (the reference's
     FileNotFoundError branch, trainer.py:103-107).
 
     ``params_like`` / ``opt_state_like`` supply the target pytree structure
-    (fresh init) the serialised arrays are poured into — shape/dtype mismatch
-    raises rather than silently mistraining.
+    (fresh init or eval_shape) the serialised arrays are poured into —
+    shape/dtype mismatch raises rather than silently mistraining.
+    ``opt_state_like=None`` skips optimizer state (inference-only restore);
+    the returned Snapshot then has ``opt_state=None``.
     """
     try:
         with fsspec.open(path, "rb") as f:
@@ -104,31 +106,28 @@ def load_snapshot(
         # permission errors must propagate, or a later save would overwrite
         # a good snapshot with fresh-init state
         return None
-    target = {
-        "version": 0,
-        "step": 0,
-        "epoch": 0,
-        "prng": np.zeros((), dtype=np.uint32),
-        "data_state": "",
-        "config": "",
-        "state": {
-            "params": _abstract_to_zeros(params_like),
-            "opt_state": _abstract_to_zeros(opt_state_like),
-        },
-    }
-    payload = serialization.from_bytes(target, blob)
+    payload = serialization.msgpack_restore(blob)
     if payload["version"] != SNAPSHOT_VERSION:
         raise ValueError(
             f"snapshot version {payload['version']} != {SNAPSHOT_VERSION}"
         )
-    _check_shapes(params_like, payload["state"]["params"], "params")
-    _check_shapes(opt_state_like, payload["state"]["opt_state"], "opt_state")
+    params = serialization.from_state_dict(
+        _abstract_to_zeros(params_like), payload["state"]["params"]
+    )
+    _check_shapes(params_like, params, "params")
+    opt_state = None
+    if opt_state_like is not None:
+        opt_state = serialization.from_state_dict(
+            _abstract_to_zeros(opt_state_like), payload["state"]["opt_state"]
+        )
+        _check_shapes(opt_state_like, opt_state, "opt_state")
+    prng = payload["prng"]
     return Snapshot(
-        params=payload["state"]["params"],
-        opt_state=payload["state"]["opt_state"],
+        params=params,
+        opt_state=opt_state,
         step=int(payload["step"]),
         epoch=int(payload["epoch"]),
-        prng=payload["prng"],
+        prng=None if prng is None or np.ndim(prng) == 0 else np.asarray(prng),
         data_state=json.loads(payload["data_state"]) if payload["data_state"] else {},
         config=json.loads(payload["config"]) if payload["config"] else {},
     )
