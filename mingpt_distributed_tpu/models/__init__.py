@@ -1,0 +1,1 @@
+from mingpt_distributed_tpu.models.api import GPT  # noqa: F401

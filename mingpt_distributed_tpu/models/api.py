@@ -1,0 +1,89 @@
+"""GPT — the object-style facade over the functional core.
+
+The reference's public surface is a torch module: ``GPT(config)`` with
+``forward(inputs, targets=None) -> (logits, loss)`` and
+``generate(idx, max_new_tokens, temperature, do_sample, top_k)``
+(/root/reference/mingpt/model.py:234-356), plus upstream minGPT's
+``GPT.from_pretrained('gpt2*')`` (north-star requirement, SURVEY §0 item 8).
+
+This class keeps those signatures exactly while the state lives where the
+TPU wants it — a params pytree the trainer/sharding machinery can own. The
+facade is deliberately thin: anything performance-critical goes through the
+same jitted pure functions (models/gpt.py, models/generate.py) the trainer
+uses; the class only carries (cfg, params, rng).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models import generate as _generate
+from mingpt_distributed_tpu.models import gpt as _gpt
+
+
+class GPT:
+    """Decoder-only transformer with the reference's public surface."""
+
+    def __init__(
+        self,
+        config: GPTConfig,
+        params: Optional[Any] = None,
+        *,
+        seed: int = 0,
+    ):
+        self.config = config.resolved()
+        self.params = (
+            params
+            if params is not None
+            else _gpt.init(jax.random.key(seed), self.config)
+        )
+        # construction-time report, as the reference prints param count +
+        # model MB (model.py:257-259)
+        print(_gpt.model_size_report(self.params, self.config))
+
+    # -- torch-module-flavoured API ------------------------------------
+    def forward(
+        self,
+        inputs,
+        targets=None,
+        *,
+        rng: Optional[jax.Array] = None,
+        deterministic: bool = True,
+    ) -> Tuple[jax.Array, Optional[jax.Array]]:
+        return _gpt.forward(
+            self.params, inputs, self.config, targets=targets, rng=rng,
+            deterministic=deterministic,
+        )
+
+    __call__ = forward
+
+    def generate(
+        self,
+        idx,
+        max_new_tokens: int,
+        temperature: float = 1.0,
+        do_sample: bool = False,
+        top_k: Optional[int] = None,
+        *,
+        rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Reference signature (model.py:323-328), KV-cached compiled decode."""
+        return _generate.generate(
+            self.params, self.config, idx, max_new_tokens,
+            temperature=temperature, do_sample=do_sample, top_k=top_k, rng=rng,
+        )
+
+    @classmethod
+    def from_pretrained(cls, model_type: str = "gpt2", **overrides) -> "GPT":
+        """Upstream-minGPT API: load OpenAI GPT-2 weights."""
+        from mingpt_distributed_tpu.models.pretrained import from_pretrained
+
+        cfg, params = from_pretrained(model_type, **overrides)
+        return cls(cfg, params)
+
+    @property
+    def num_params(self) -> int:
+        return _gpt.param_count(self.params)

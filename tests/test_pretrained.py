@@ -1,0 +1,126 @@
+"""from_pretrained parity: our forward must reproduce torch GPT-2 logits
+bit-closely on the same (randomly initialised, locally built — zero-egress)
+weights. This is the oracle test SURVEY §4 prescribes."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+
+from mingpt_distributed_tpu.config import ConfigError, GPTConfig  # noqa: E402
+from mingpt_distributed_tpu.models import generate as gen  # noqa: E402
+from mingpt_distributed_tpu.models import gpt  # noqa: E402
+from mingpt_distributed_tpu.models.pretrained import (  # noqa: E402
+    config_for_pretrained,
+    load_hf_state_dict,
+)
+
+
+@pytest.fixture(scope="module")
+def hf_small():
+    """A small random GPT2LMHeadModel built locally (no download)."""
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=32, n_embd=48, n_layer=3, n_head=3,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    torch.manual_seed(0)
+    model = transformers.GPT2LMHeadModel(hf_cfg)
+    model.eval()
+    return model
+
+
+def our_cfg():
+    return GPTConfig.make(
+        n_layer=3, n_head=3, n_embd=48, vocab_size=97, block_size=32,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="float32", tie_weights=True,
+    )
+
+
+def test_logit_parity_with_torch(hf_small):
+    cfg = our_cfg()
+    params = load_hf_state_dict(hf_small.state_dict(), cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 97, (2, 32))
+    with torch.no_grad():
+        want = hf_small(torch.tensor(tokens)).logits.numpy()
+    got, _ = gpt.forward(params, tokens.astype(np.int32), cfg)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_loss_parity_with_torch(hf_small):
+    cfg = our_cfg()
+    params = load_hf_state_dict(hf_small.state_dict(), cfg)
+    rng = np.random.default_rng(2)
+    tokens = rng.integers(0, 97, (2, 32))
+    t = torch.tensor(tokens)
+    with torch.no_grad():
+        # HF computes CE over shifted (predict-next) positions
+        out = hf_small(t, labels=t)
+    x, y = tokens[:, :-1], tokens[:, 1:]
+    _, loss = gpt.forward(
+        params, x.astype(np.int32), cfg, targets=y.astype(np.int32)
+    )
+    np.testing.assert_allclose(float(loss), float(out.loss), rtol=1e-4)
+
+
+def test_generation_parity_greedy(hf_small):
+    cfg = our_cfg()
+    params = load_hf_state_dict(hf_small.state_dict(), cfg)
+    prompt = np.array([[5, 17, 3]])
+    with torch.no_grad():
+        want = hf_small.generate(
+            torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+    got = gen.generate(params, cfg, prompt.astype(np.int32), 8)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_untied_head_materialised(hf_small):
+    cfg = GPTConfig.make(
+        n_layer=3, n_head=3, n_embd=48, vocab_size=97, block_size=32,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="float32", tie_weights=False,
+    )
+    params = load_hf_state_dict(hf_small.state_dict(), cfg)
+    assert params["head"].shape == (48, 97)
+    np.testing.assert_allclose(params["head"], params["wte"].T)
+
+
+def test_unknown_pretrained_rejected():
+    with pytest.raises(ConfigError, match="from_pretrained supports"):
+        config_for_pretrained("gpt5")
+
+
+def test_missing_key_reported(hf_small):
+    sd = dict(hf_small.state_dict())
+    sd.pop("transformer.h.0.ln_1.weight")
+    with pytest.raises(KeyError, match="ln_1.weight"):
+        load_hf_state_dict(sd, our_cfg())
+
+
+def test_position_budget_checked(hf_small):
+    cfg_too_long = GPTConfig.make(
+        n_layer=3, n_head=3, n_embd=48, vocab_size=97, block_size=64,
+        dtype="float32", tie_weights=True,
+    )
+    with pytest.raises(ValueError, match="positions"):
+        load_hf_state_dict(hf_small.state_dict(), cfg_too_long)
+
+
+def test_gpt_class_facade(hf_small, capsys):
+    from mingpt_distributed_tpu.models import GPT
+    cfg = our_cfg()
+    params = load_hf_state_dict(hf_small.state_dict(), cfg)
+    m = GPT(cfg, params)
+    assert "params" in capsys.readouterr().out  # construction-time size print
+    tokens = np.zeros((1, 8), dtype=np.int32)
+    logits, loss = m(tokens, targets=tokens)
+    assert logits.shape == (1, 8, 97) and loss is not None
+    out = m.generate([1, 2, 3], 5)
+    assert out.shape == (1, 8)
+    assert m.num_params > 0
