@@ -116,28 +116,28 @@ def init(key: jax.Array, cfg: GPTConfig) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def _attention_dispatch(cfg: GPTConfig):
+def _attention_dispatch(cfg: GPTConfig, mesh=None):
     """Select the attention implementation named by cfg.attention.
 
     "einsum" is the oracle (ops/attention.py). "flash" is the Pallas
-    blockwise kernel (ops/flash_attention.py). "ring" is driven from the
-    sequence-parallel path in parallel/ring_attention.py, not from inside
-    this per-shard forward.
+    blockwise kernel (ops/flash_attention.py). "ring" is the
+    sequence-parallel path (parallel/ring_attention.py) — it needs the mesh,
+    which is the one piece of parallelism context that can't stay outside
+    the model: the ring's collectives live inside attention itself.
     """
     if cfg.attention == "einsum":
         return attn_ops.causal_attention
     if cfg.attention == "flash":
-        try:
-            from mingpt_distributed_tpu.ops import flash_attention
-        except ImportError as e:
-            raise NotImplementedError(
-                f"flash attention kernel unavailable: {e}"
-            ) from None
+        from mingpt_distributed_tpu.ops import flash_attention
+
         return flash_attention.causal_attention
-    raise NotImplementedError(
-        f"attention={cfg.attention!r} is not usable from the dense forward; "
-        "use parallel.ring_attention for sequence-parallel execution"
-    )
+    if cfg.attention == "ring":
+        from mingpt_distributed_tpu.parallel import ring_attention
+
+        return lambda q, k, v, **kw: ring_attention.ring_causal_attention(
+            q, k, v, mesh, **kw
+        )
+    raise NotImplementedError(f"attention={cfg.attention!r}")
 
 
 def _norm(x, scale, bias, cfg: GPTConfig):
@@ -153,6 +153,7 @@ def _block(
     rope: Optional[Tuple[jax.Array, jax.Array]],
     drop_key: Optional[jax.Array],
     deterministic: bool,
+    mesh=None,
 ) -> jax.Array:
     """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
     b, t, d = x.shape
@@ -170,7 +171,7 @@ def _block(
         cos, sin = rope
         q = attn_ops.apply_rope(q, cos, sin)
         k = attn_ops.apply_rope(k, cos, sin)
-    att = _attention_dispatch(cfg)(
+    att = _attention_dispatch(cfg, mesh)(
         q, k, v,
         attn_pdrop=cfg.attn_pdrop,
         dropout_key=k_attn,
@@ -197,6 +198,7 @@ def forward(
     targets: Optional[jax.Array] = None,  # (B, T) int32, -1 = ignore
     rng: Optional[jax.Array] = None,
     deterministic: bool = True,
+    mesh=None,  # required only for attention="ring" (see _attention_dispatch)
 ) -> Tuple[jax.Array, Optional[jax.Array]]:
     """Full forward pass -> (logits (B, T, V) float32, loss or None).
 
@@ -231,13 +233,13 @@ def forward(
     if deterministic:
         layer_keys = None
         def body(carry, blk):
-            return _block(carry, blk, cfg, rope, None, True), None
+            return _block(carry, blk, cfg, rope, None, True, mesh), None
         xs = params["blocks"]
     else:
         layer_keys = jax.random.split(rng, nl)
         def body(carry, scanned):
             blk, key = scanned
-            return _block(carry, blk, cfg, rope, key, False), None
+            return _block(carry, blk, cfg, rope, key, False, mesh), None
         xs = (params["blocks"], layer_keys)
 
     step = jax.checkpoint(body) if cfg.remat else body

@@ -1,0 +1,306 @@
+"""Causal flash attention — Pallas TPU kernel (FlashAttention-2 style).
+
+Replaces, on the hot path, the einsum oracle in ops/attention.py (itself the
+intended semantics of the reference's fused torch attention,
+/root/reference/mingpt/model.py:147-165): same math, different memory story.
+The einsum path materialises the (B, H, T, S) logits in HBM; this kernel
+streams K/V blocks through VMEM with an online softmax, so attention memory
+is O(T·d) — the property that makes long block_size HBM-feasible
+(SURVEY §5.7's prescription for this framework).
+
+Shapes follow ops.attention.causal_attention: q (B, T, H, hd), k/v
+(B, S, KV, hd) with GQA handled by broadcasting outside the kernel (autodiff
+then sums dk/dv over the query-head group for free).
+
+Forward: grid (B*H, T/BQ); each cell loads its q block, loops over k blocks
+up to the diagonal (causal), maintaining running max m, denominator l and
+accumulator acc; also emits the log-sum-exp per row for the backward.
+Backward: two kernels (dq over q blocks; dk/dv over k blocks) recompute the
+probabilities from the saved LSE — no stored attention matrix anywhere.
+
+Falls back to the einsum oracle when the shape/config doesn't fit the kernel
+(attention dropout on, decode-time cross lengths, T not a multiple of the
+block) — correctness is never gated on the fast path. On CPU the kernel runs
+in Pallas interpret mode, which is how the parity tests exercise it.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from mingpt_distributed_tpu.ops import attention as attn_ops
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _block_sizes(t: int) -> Optional[int]:
+    """Pick a square block size dividing T, or None if the kernel won't fit."""
+    for b in (512, 256, 128):
+        if t % b == 0:
+            return b
+    if t <= 128 and t % 8 == 0:
+        return t
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Forward kernel
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, t):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, hd)
+    hd = q.shape[-1]
+
+    def body(kb, carry):
+        m, l, acc = carry
+        kblk = k_ref[0, pl.ds(kb * block, block), :]
+        vblk = v_ref[0, pl.ds(kb * block, block), :]
+        s = jax.lax.dot_general(
+            q, kblk.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (BQ, BK)
+        q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p.astype(vblk.dtype), vblk,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l, acc
+
+    m0 = jnp.full((block, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block, 1), jnp.float32)
+    acc0 = jnp.zeros((block, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, qi + 1, body, (m0, l0, acc0))
+
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l))[:, 0]
+
+
+def _flash_fwd(q, k, v, scale, block):
+    """q/k/v: (BH, T, hd) -> (out (BH, T, hd), lse (BH, T))."""
+    bh, t, hd = q.shape
+    grid = (bh, t // block)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block=block, t=t),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               *, scale, block, t):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]
+    delta = delta_ref[0][:, None]
+    hd = q.shape[-1]
+
+    def body(kb, dq):
+        kblk = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        vblk = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * scale, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, kblk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, qi + 1, body, jnp.zeros((block, hd), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, block, t):
+    kb = pl.program_id(1)
+    nq = t // block
+    kblk = k_ref[0].astype(jnp.float32)  # (BK, hd)
+    vblk = v_ref[0].astype(jnp.float32)
+    hd = kblk.shape[-1]
+
+    def body(qb, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qb * block, block)][:, None]
+        delta = delta_ref[0, pl.ds(qb * block, block)][:, None]
+        s = jax.lax.dot_general(
+            q * scale, kblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        q_pos = qb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
+        k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (BQ, BK)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do, vblk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    # only q blocks at or below the diagonal see this k block
+    dk0 = jnp.zeros((block, hd), jnp.float32)
+    dv0 = jnp.zeros((block, hd), jnp.float32)
+    dk, dv = jax.lax.fori_loop(kb, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, scale, block):
+    bh, t, hd = q.shape
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+    grid = (bh, t // block)
+    qspec_blk = pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0))
+    qspec_full = pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0))
+    vec_blk = pl.BlockSpec((1, block), lambda b, i: (b, i))
+    vec_full = pl.BlockSpec((1, t), lambda b, i: (b, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, block=block, t=t),
+        grid=grid,
+        in_specs=[qspec_blk, qspec_full, qspec_full, qspec_blk, vec_blk, vec_blk],
+        out_specs=[qspec_blk],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, hd), q.dtype)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)[0]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, block=block, t=t),
+        grid=grid,
+        in_specs=[qspec_full, qspec_blk, qspec_blk, qspec_full, vec_full, vec_full],
+        out_specs=[qspec_blk, qspec_blk],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, hd), k.dtype),
+            jax.ShapeDtypeStruct((bh, t, hd), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper in the model's (B, T, H, hd) layout
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale: float, block: int):
+    out, _ = _flash_fwd(q, k, v, scale, block)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, block):
+    out, lse = _flash_fwd(q, k, v, scale, block)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(scale, block, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, block)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def causal_attention(
+    q: jax.Array,  # (B, T, H, hd)
+    k: jax.Array,  # (B, S, KV, hd)
+    v: jax.Array,
+    *,
+    attn_pdrop: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Drop-in for ops.attention.causal_attention, flash-accelerated.
+
+    Falls back to the einsum oracle whenever the kernel doesn't apply:
+    attention dropout active, decode-style q/k length mismatch, or T not
+    tileable. The fallback IS the definition of correctness; the kernel is
+    tested for parity against it.
+    """
+    b, t, h, hd = q.shape
+    s = k.shape[1]
+    block = _block_sizes(t)
+    use_flash = (
+        block is not None
+        and t == s
+        and (deterministic or attn_pdrop == 0.0)
+        and isinstance(kv_offset, int)
+        and kv_offset == 0
+    )
+    if not use_flash:
+        return attn_ops.causal_attention(
+            q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
+            deterministic=deterministic, kv_offset=kv_offset,
+        )
+    kv = k.shape[2]
+    k = attn_ops.repeat_kv(k, h // kv)
+    v = attn_ops.repeat_kv(v, h // kv)
+    scale = 1.0 / math.sqrt(hd)
+    # (B, T, H, hd) -> (B*H, T, hd)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block)
+    return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)

@@ -1,0 +1,128 @@
+"""Ring attention: sequence-parallel causal attention over the ``sp`` axis.
+
+First-class long-context support (SURVEY §5.7 — the reference's strategy is
+"crop to block_size"; this framework shards the *sequence* instead). Each
+device on the ``sp`` mesh axis holds a contiguous sequence chunk of Q/K/V;
+K/V chunks rotate around the ring with ``lax.ppermute`` while every device
+accumulates its queries' attention with an online (streaming) softmax — the
+same math as the flash kernel (ops/flash_attention.py), distributed: no
+device ever materialises the full sequence, so max context scales linearly
+with the ring size.
+
+Causality around the ring: chunks are visited starting with the device's own
+(step 0 = self-attention on the diagonal chunk, which guarantees every query
+row sees at least one valid key before any fully-masked future chunk is
+folded in — with the finite NEG_INF masking this keeps the accumulators
+NaN-free). Fully-masked chunks then contribute exactly zero.
+
+The rotation is a lax.scan (static ring length) so the whole thing is
+reverse-differentiable — gradients flow through ppermute's transpose.
+Implemented as a shard_map "manual" region usable inside the jitted,
+GSPMD-partitioned train step.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mingpt_distributed_tpu.ops import attention as attn_ops
+from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
+
+NEG_INF = -1e30
+
+
+def _ring_shard(q, k, v, *, axis_name: str, scale: float):
+    """Per-shard ring attention. q/k/v: (b, c, h, hd) local chunks."""
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, c, h, hd = q.shape
+    qf = q.astype(jnp.float32) * scale
+
+    q_pos = idx * c + jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
+    k_local = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
+
+    perm = None  # filled per-call below; scan body closes over axis size
+
+    def body(carry, i):
+        m, l, acc, kc, vc = carry
+        src = (idx - i) % n  # origin device of the chunk we currently hold
+        s = jnp.einsum(
+            "bthd,bshd->bhts", qf, kc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        k_pos = src * c + k_local
+        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum(
+            "bhts,bshd->bhtd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # rotate K/V one hop around the ring (ICI neighbour exchange)
+        shift = [(j, (j + 1) % n) for j in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, shift)
+        vc = jax.lax.ppermute(vc, axis_name, shift)
+        return (m_new, l, acc, kc, vc), None
+
+    m0 = jnp.full((b, h, c, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, c, 1), jnp.float32)
+    acc0 = jnp.zeros((b, h, c, hd), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n)
+    )
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhtd->bthd", out).astype(q.dtype)
+
+
+def ring_causal_attention(
+    q: jax.Array,  # (B, T, H, hd) global
+    k: jax.Array,  # (B, T, KV, hd)
+    v: jax.Array,
+    mesh: Optional[Mesh],
+    *,
+    attn_pdrop: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """Sequence-parallel causal attention (einsum-oracle fallback when the
+    ring doesn't apply: no mesh / sp==1 / dropout / decode shapes)."""
+    b, t, h, hd = q.shape
+    usable = (
+        mesh is not None
+        and mesh.shape.get("sp", 1) > 1
+        and t == k.shape[1]
+        and (deterministic or attn_pdrop == 0.0)
+        and isinstance(kv_offset, int)
+        and kv_offset == 0
+        and t % mesh.shape["sp"] == 0
+    )
+    if not usable:
+        return attn_ops.causal_attention(
+            q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
+            deterministic=deterministic, kv_offset=kv_offset,
+        )
+    kv = k.shape[2]
+    k = attn_ops.repeat_kv(k, h // kv)
+    v = attn_ops.repeat_kv(v, h // kv)
+    scale = 1.0 / math.sqrt(hd)
+    # heads may be tensor-parallel; replicate over tp if indivisible
+    head_ax = "tp" if h % mesh.shape.get("tp", 1) == 0 else None
+    spec = P(BATCH_AXES, "sp", head_ax, None)
+    fn = jax.shard_map(
+        partial(_ring_shard, axis_name="sp", scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

@@ -58,7 +58,9 @@ TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
 state_shardings = mesh_lib.state_shardings
 
 
-def make_train_step(cfg: GPTConfig, optimizer: optax.GradientTransformation):
+def make_train_step(
+    cfg: GPTConfig, optimizer: optax.GradientTransformation, mesh=None
+):
     """forward+backward+update as one pure function of (state, batch, rng)."""
 
     def train_step(state: TrainState, batch, base_rng):
@@ -73,6 +75,7 @@ def make_train_step(cfg: GPTConfig, optimizer: optax.GradientTransformation):
                 params, x, cfg, targets=y,
                 rng=None if deterministic else rng,
                 deterministic=deterministic,
+                mesh=mesh,
             )
             return loss
 
@@ -90,10 +93,10 @@ def make_train_step(cfg: GPTConfig, optimizer: optax.GradientTransformation):
     return train_step
 
 
-def make_eval_step(cfg: GPTConfig):
+def make_eval_step(cfg: GPTConfig, mesh=None):
     def eval_step(state: TrainState, batch):
         x, y = batch
-        _, loss = gpt.forward(state["params"], x, cfg, targets=y)
+        _, loss = gpt.forward(state["params"], x, cfg, targets=y, mesh=mesh)
         return loss
 
     return eval_step
@@ -209,13 +212,13 @@ class GPTTrainer:
 
         # --- compiled steps ----------------------------------------------
         self._train_step = jax.jit(
-            make_train_step(gpt_config, self.optimizer),
+            make_train_step(gpt_config, self.optimizer, self.mesh),
             in_shardings=(self.shardings, (self.batch_sharding,) * 2, self.repl),
             out_shardings=(self.shardings, self.repl),
             donate_argnums=(0,),
         )
         self._eval_step = jax.jit(
-            make_eval_step(gpt_config),
+            make_eval_step(gpt_config, self.mesh),
             in_shardings=(self.shardings, (self.batch_sharding,) * 2),
             out_shardings=self.repl,
         )

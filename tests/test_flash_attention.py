@@ -1,0 +1,115 @@
+"""Flash-attention kernel parity vs the einsum oracle (fwd + grads), run in
+Pallas interpret mode on CPU (SURVEY §7 hard-part #4: correctness vs the
+oracle first, performance on hardware second)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models import gpt
+from mingpt_distributed_tpu.ops import attention as attn_ops
+from mingpt_distributed_tpu.ops import flash_attention as flash
+
+
+def qkv(b=2, t=128, h=4, kv=None, hd=32, seed=0, dtype=jnp.float32):
+    kv = kv or h
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, hd), dtype)
+    k = jax.random.normal(ks[1], (b, t, kv, hd), dtype)
+    v = jax.random.normal(ks[2], (b, t, kv, hd), dtype)
+    return q, k, v
+
+
+def test_forward_parity():
+    q, k, v = qkv()
+    want = attn_ops.causal_attention(q, k, v)
+    got = flash.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_parity_gqa():
+    q, k, v = qkv(h=4, kv=2)
+    want = attn_ops.causal_attention(q, k, v)
+    got = flash.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_forward_parity_multiblock():
+    # T=256 -> block 128 x 2: exercises the streaming-softmax accumulation
+    q, k, v = qkv(t=256, seed=3)
+    want = attn_ops.causal_attention(q, k, v)
+    got = flash.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_gradient_parity():
+    q, k, v = qkv(t=128, seed=5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v)))
+
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    for want, got, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_gradient_parity_gqa_multiblock():
+    q, k, v = qkv(t=256, h=4, kv=1, seed=7)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v)))
+
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    for want, got, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
+def test_fallback_paths_route_to_oracle():
+    # dropout active -> einsum fallback (still correct, just not flash)
+    q, k, v = qkv(t=64)
+    out = flash.causal_attention(
+        q, k, v, attn_pdrop=0.5, dropout_key=jax.random.key(0),
+        deterministic=False,
+    )
+    assert out.shape == q.shape
+    # decode-style (q_len 1 vs cache 64) -> fallback with kv_offset
+    out = flash.causal_attention(q[:, :1], k, v, kv_offset=63)
+    assert out.shape == (2, 1, 4, 32)
+    # odd T -> fallback
+    out = flash.causal_attention(q[:, :37], k[:, :37], v[:, :37])
+    want = attn_ops.causal_attention(q[:, :37], k[:, :37], v[:, :37])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_model_forward_with_flash_matches_einsum():
+    """End-to-end: gpt_config.attention=flash must reproduce einsum logits."""
+    base = dict(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=50, block_size=128,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    cfg_e = GPTConfig.make(**base, attention="einsum")
+    cfg_f = GPTConfig.make(**base, attention="flash")
+    params = gpt.init(jax.random.key(0), cfg_e)
+    tokens = jax.random.randint(jax.random.key(1), (2, 128), 0, 50)
+    le, _ = gpt.forward(params, tokens, cfg_e, targets=tokens)
+    lf, _ = gpt.forward(params, tokens, cfg_f, targets=tokens)
+    np.testing.assert_allclose(np.asarray(lf), np.asarray(le),
+                               rtol=2e-4, atol=2e-4)
