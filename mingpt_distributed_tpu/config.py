@@ -266,6 +266,7 @@ class TrainerConfig:
     eval_every: int = 1           # epochs between eval passes
     eval_batches: Optional[int] = None  # cap eval batches; None = full pass
     metrics_jsonl: Optional[str] = None  # JSONL metrics sink (§5.5 upgrade)
+    prefetch: int = 2  # background batch-prefetch depth; 0 disables
     mesh: MeshConfig = field(default_factory=MeshConfig)
     profile_dir: Optional[str] = None   # jax.profiler trace output
     profile_steps: Tuple[int, int] = (10, 20)

@@ -33,6 +33,11 @@ import numpy as np
 
 from mingpt_distributed_tpu.config import DataConfig
 
+try:  # C batch gather (runtime/native_batcher.c; build: make -C runtime native)
+    from mingpt_distributed_tpu.data import _native_batcher
+except ImportError:  # pure-numpy fallback — behaviourally identical
+    _native_batcher = None
+
 
 class CharDataset:
     """A corpus of characters with next-char (x, y) windows of ``block_size``."""
@@ -105,10 +110,24 @@ class CharView:
         return max(0, (self.stop - self.start) - self.block_size)
 
     def gather(self, indices: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """Vectorised (x, y) batch for window start offsets within this view."""
-        starts = np.asarray(indices, dtype=np.int64) + self.start
-        offs = np.arange(self.block_size + 1, dtype=np.int64)
-        chunks = self.parent.data[starts[:, None] + offs[None, :]]
+        """Vectorised (x, y) batch for window start offsets within this view.
+
+        Uses the C extension's GIL-releasing gather when built (so a prefetch
+        thread overlaps batch assembly with device compute), else numpy.
+        """
+        starts = np.ascontiguousarray(
+            np.asarray(indices, dtype=np.int64) + self.start
+        )
+        if _native_batcher is not None:
+            blob = _native_batcher.gather_windows(
+                np.ascontiguousarray(self.parent.data), starts, self.block_size
+            )
+            chunks = np.frombuffer(blob, dtype=np.int32).reshape(
+                len(starts), self.block_size + 1
+            )
+        else:
+            offs = np.arange(self.block_size + 1, dtype=np.int64)
+            chunks = self.parent.data[starts[:, None] + offs[None, :]]
         return chunks[:, :-1].astype(np.int32), chunks[:, 1:].astype(np.int32)
 
 

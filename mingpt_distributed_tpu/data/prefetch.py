@@ -1,0 +1,49 @@
+"""Background batch prefetching — the DataLoader-workers analogue.
+
+The reference leans on torch DataLoader worker processes + pinned memory
+(/root/reference/mingpt/trainer.py:73-78, ``dl_num_workers``) to keep the
+accelerator fed. The TPU shape of that problem is smaller — batches are one
+big numpy gather, and the real overlap is with the device's async dispatch —
+so one daemon thread with a bounded queue suffices: it runs the (C, GIL-
+releasing — runtime/native_batcher.c) gather for batch N+k while the chip
+executes batch N.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+
+class PrefetchIterator:
+    """Wrap a batch iterator with a depth-bounded background prefetch thread."""
+
+    _DONE = object()
+
+    def __init__(self, source: Iterator, depth: int = 2):
+        self._source = source
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._err: BaseException | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            for item in self._source:
+                self._queue.put(item)
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._queue.put(self._DONE)
+
+    def __iter__(self) -> "PrefetchIterator":
+        return self
+
+    def __next__(self):
+        item = self._queue.get()
+        if item is self._DONE:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item

@@ -274,13 +274,22 @@ class GPTTrainer:
         py_step = self.step
         prev_metrics = None
         for epoch in range(self.start_epoch, cfg.max_epochs):
-            for xy in self.train_iter.epoch_batches():
+            # the prefetch thread advances the iterator's internal state
+            # ahead of consumption; `consumed` is the truth for resume
+            consumed = self.train_iter.state.step_in_epoch
+            source = self.train_iter.epoch_batches()
+            if cfg.prefetch > 0:
+                from mingpt_distributed_tpu.data.prefetch import PrefetchIterator
+
+                source = PrefetchIterator(source, depth=cfg.prefetch)
+            for xy in source:
                 batch = self._put_batch(xy)
                 self.state, m = self._train_step(self.state, batch, self.base_rng)
                 if prev_metrics is not None:
                     jax.block_until_ready(prev_metrics)
                 prev_metrics = m
                 py_step = step = py_step + 1
+                consumed += 1
                 if step % cfg.log_every == 0 or (
                     cfg.max_steps and step >= cfg.max_steps
                 ):
@@ -293,6 +302,13 @@ class GPTTrainer:
                 if cfg.max_steps and step >= cfg.max_steps:
                     stop = True
                     break
+            if stop:
+                # re-sync iterator state to the batches actually trained on
+                # (prefetch ran ahead); resume continues at exactly here
+                self.train_iter.state = IteratorState(
+                    epoch=epoch, step_in_epoch=consumed,
+                    seed=self.train_iter.state.seed,
+                )
             epoch_done = epoch + (0 if stop else 1)
             if self.test_iter is not None and (
                 stop or (epoch + 1) % cfg.eval_every == 0

@@ -1,0 +1,95 @@
+/* native_batcher: C implementation of the data layer's hot loop.
+ *
+ * The reference delegates its data hot path to torch's native DataLoader
+ * machinery (pin-memory workers, /root/reference/mingpt/trainer.py:73-81,
+ * dl_num_workers config trainer.py:26). This extension is that role for the
+ * TPU build: the windowed (x, y) batch gather runs in C with the GIL
+ * released, so a Python prefetch thread (data/prefetch.py) can overlap host
+ * batch assembly with device compute.
+ *
+ * One entry point:
+ *   gather_windows(data, starts, block_size) -> bytes
+ *     data:   contiguous int32 buffer (the encoded corpus)
+ *     starts: contiguous int64 buffer (window start offsets)
+ *     result: (len(starts), block_size+1) int32 array bytes — callers view
+ *             it with numpy and slice x = [:, :-1], y = [:, 1:].
+ *
+ * Built with the CPython C API only (no pybind11 in the image); see
+ * runtime/Makefile target `native`.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+static PyObject* gather_windows(PyObject* self, PyObject* args) {
+  Py_buffer data, starts;
+  Py_ssize_t block_size;
+  if (!PyArg_ParseTuple(args, "y*y*n", &data, &starts, &block_size)) {
+    return NULL;
+  }
+  if (data.len % 4 != 0) {
+    PyBuffer_Release(&data);
+    PyBuffer_Release(&starts);
+    PyErr_SetString(PyExc_ValueError, "data must be an int32 buffer");
+    return NULL;
+  }
+  if (starts.len % 8 != 0) {
+    PyBuffer_Release(&data);
+    PyBuffer_Release(&starts);
+    PyErr_SetString(PyExc_ValueError, "starts must be an int64 buffer");
+    return NULL;
+  }
+  const int32_t* corpus = (const int32_t*)data.buf;
+  Py_ssize_t corpus_len = data.len / 4;
+  const int64_t* offs = (const int64_t*)starts.buf;
+  Py_ssize_t n = starts.len / 8;
+  Py_ssize_t window = block_size + 1;
+
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    if (offs[i] < 0 || offs[i] + window > corpus_len) {
+      PyBuffer_Release(&data);
+      PyBuffer_Release(&starts);
+      PyErr_Format(PyExc_IndexError,
+                   "window start %lld out of range (corpus %lld, window %lld)",
+                   (long long)offs[i], (long long)corpus_len,
+                   (long long)window);
+      return NULL;
+    }
+  }
+
+  PyObject* out = PyBytes_FromStringAndSize(NULL, n * window * 4);
+  if (out == NULL) {
+    PyBuffer_Release(&data);
+    PyBuffer_Release(&starts);
+    return NULL;
+  }
+  int32_t* dst = (int32_t*)PyBytes_AS_STRING(out);
+
+  Py_BEGIN_ALLOW_THREADS
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    memcpy(dst + i * window, corpus + offs[i], window * 4);
+  }
+  Py_END_ALLOW_THREADS
+
+  PyBuffer_Release(&data);
+  PyBuffer_Release(&starts);
+  return out;
+}
+
+static PyMethodDef Methods[] = {
+    {"gather_windows", gather_windows, METH_VARARGS,
+     "gather_windows(data_int32, starts_int64, block_size) -> bytes"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_native_batcher",
+    "C batch gather for the char dataset (GIL-releasing)", -1, Methods,
+};
+
+PyMODINIT_FUNC PyInit__native_batcher(void) {
+  return PyModule_Create(&moduledef);
+}

@@ -115,3 +115,60 @@ def test_batch_size_must_divide():
     train, _ = ds.split()
     with pytest.raises(ValueError, match="divisible"):
         ShardedBatchIterator(train, 10, process_count=4)
+
+
+def test_native_batcher_matches_numpy():
+    """C gather (runtime/native_batcher.c) must agree with the numpy path."""
+    from mingpt_distributed_tpu.data import char_dataset as cd
+    if cd._native_batcher is None:
+        pytest.skip("native batcher not built (make -C runtime native)")
+    ds = make_ds(block_size=8)
+    train, _ = ds.split()
+    idx = np.array([0, 5, 17, 101])
+    native_x, native_y = train.gather(idx)
+    # force the numpy path
+    saved = cd._native_batcher
+    cd._native_batcher = None
+    try:
+        np_x, np_y = train.gather(idx)
+    finally:
+        cd._native_batcher = saved
+    np.testing.assert_array_equal(native_x, np_x)
+    np.testing.assert_array_equal(native_y, np_y)
+
+
+def test_native_batcher_bounds_checked():
+    from mingpt_distributed_tpu.data import char_dataset as cd
+    if cd._native_batcher is None:
+        pytest.skip("native batcher not built")
+    ds = make_ds(block_size=8)
+    with pytest.raises(IndexError):
+        cd._native_batcher.gather_windows(
+            np.ascontiguousarray(ds.data), np.array([10**9], dtype=np.int64), 8
+        )
+
+
+def test_prefetch_iterator_matches_direct():
+    from mingpt_distributed_tpu.data.prefetch import PrefetchIterator
+    ds = make_ds(block_size=8)
+    train, _ = ds.split()
+    it1 = ShardedBatchIterator(train, 4, seed=3)
+    direct = [x.copy() for x, _ in it1.epoch_batches()]
+    it2 = ShardedBatchIterator(train, 4, seed=3)
+    fetched = [x.copy() for x, _ in PrefetchIterator(it2.epoch_batches())]
+    assert len(direct) == len(fetched)
+    for a, b in zip(direct, fetched):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_prefetch_iterator_propagates_errors():
+    from mingpt_distributed_tpu.data.prefetch import PrefetchIterator
+
+    def boom():
+        yield 1
+        raise RuntimeError("source failed")
+
+    it = PrefetchIterator(boom())
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="source failed"):
+        next(it)
