@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# Launch training on every worker of a Cloud TPU pod slice.
+#
+# The TPU-native analogue of the reference's Slurm launcher
+# (/root/reference/mingpt/slurm/slurm_run.sh): where that script resolves the
+# head-node IP and has torchrun fork one process per GPU with a c10d
+# rendezvous on port 29500, a TPU pod slice runs ONE identical process per
+# worker host and jax.distributed.initialize() discovers the topology from
+# the TPU metadata (no rendezvous port to manage). The launcher's whole job
+# is therefore "run the same command everywhere" — which is exactly what
+# `gcloud ... ssh --worker=all` does.
+#
+# Usage:
+#   ./launch/tpu_pod_run.sh <tpu-name> <zone> [train.py args...]
+# Example:
+#   ./launch/tpu_pod_run.sh mingpt-v4-32 us-central2-b \
+#       trainer_config.max_epochs=10 data_config.path=gs://bucket/corpus.txt
+#
+# Pre-flight (optional but recommended — the mpi_hello_world step of the
+# reference runbook): build and run the native PJRT smoke test on each worker
+# first:
+#   gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+#     --command "cd ~/mingpt_distributed_tpu/runtime && make && \
+#                PJRT_PLUGIN_PATH=/lib/libtpu.so ./pjrt_smoke"
+
+set -euo pipefail
+
+TPU_NAME="${1:?usage: tpu_pod_run.sh <tpu-name> <zone> [train args...]}"
+ZONE="${2:?usage: tpu_pod_run.sh <tpu-name> <zone> [train args...]}"
+shift 2
+
+REPO_DIR="${REPO_DIR:-\$HOME/mingpt_distributed_tpu}"
+LOGLEVEL="${LOGLEVEL:-INFO}"   # reference parity: slurm_run.sh:15
+
+# Every worker runs the identical command; process identity comes from the
+# TPU runtime (jax.process_index()), not from env wrangling here.
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" --zone "$ZONE" --worker=all \
+  --command "cd $REPO_DIR && LOGLEVEL=$LOGLEVEL python train.py $*"

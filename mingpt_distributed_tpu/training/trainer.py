@@ -290,6 +290,19 @@ class GPTTrainer:
                 prev_metrics = m
                 py_step = step = py_step + 1
                 consumed += 1
+                # jax.profiler trace window (SURVEY §5.1: the reference has
+                # no profiler at all; xplane output feeds Perfetto/XProf)
+                if cfg.profile_dir and self.is_writer:
+                    if step == cfg.profile_steps[0]:
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        self._tracing = True
+                    elif step == cfg.profile_steps[1] and getattr(
+                        self, "_tracing", False
+                    ):
+                        jax.block_until_ready(m)
+                        jax.profiler.stop_trace()
+                        self._tracing = False
+                        print(f"profiler trace written to {cfg.profile_dir}")
                 if step % cfg.log_every == 0 or (
                     cfg.max_steps and step >= cfg.max_steps
                 ):
