@@ -166,7 +166,7 @@ class GPTConfig:
             raise ConfigError(
                 f"n_head={self.n_head} not divisible by n_kv_head={kv}"
             )
-        if self.attention not in ("einsum", "flash", "ring"):
+        if self.attention not in ("einsum", "flash", "ring", "ulysses"):
             raise ConfigError(f"unknown attention impl {self.attention!r}")
         if self.rope and (self.n_embd // self.n_head) % 2 != 0:
             raise ConfigError(

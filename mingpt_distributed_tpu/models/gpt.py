@@ -137,6 +137,12 @@ def _attention_dispatch(cfg: GPTConfig, mesh=None):
         return lambda q, k, v, **kw: ring_attention.ring_causal_attention(
             q, k, v, mesh, **kw
         )
+    if cfg.attention == "ulysses":
+        from mingpt_distributed_tpu.parallel import ulysses
+
+        return lambda q, k, v, **kw: ulysses.ulysses_causal_attention(
+            q, k, v, mesh, **kw
+        )
     raise NotImplementedError(f"attention={cfg.attention!r}")
 
 

@@ -1,0 +1,92 @@
+"""Ulysses attention: all-to-all sequence parallelism over the ``sp`` axis.
+
+The second long-context strategy (complementing ring attention,
+parallel/ring_attention.py — SURVEY §2.2 lists both as absent from the
+reference; this framework treats long context as first-class). Where the
+ring streams K/V chunks around the ICI ring with an online softmax, Ulysses
+(DeepSpeed-style) re-shards: an all-to-all converts the layout from
+"sequence-sharded, all heads" to "head-sharded, full sequence", each device
+runs ordinary *local* causal attention for its head group (reusing the
+Pallas flash kernel — the two compose), and a second all-to-all restores
+sequence sharding.
+
+Trade-offs vs ring: two all-to-alls of the whole activation per layer
+instead of n_ring K/V hops, no wasted upper-triangle compute, but requires
+``n_head % sp == 0`` and holds the full sequence per device for the local
+attention (memory bound by T·H/sp·hd, fine when flash attention keeps the
+score matrix blockwise).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from mingpt_distributed_tpu.ops import attention as attn_ops
+from mingpt_distributed_tpu.ops import flash_attention as flash
+from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
+
+
+def _ulysses_shard(q, k, v, *, axis_name: str):
+    """Per-shard: (b, T/n, H, hd) -> attention output, via two all-to-alls."""
+    # seq-sharded/all-heads -> head-sharded/full-seq
+    a2a = partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
+        tiled=True,
+    )
+    qh, kh, vh = a2a(q), a2a(k), a2a(v)  # (b, T, H/n, hd)
+    # local attention over the full sequence for this head group; the flash
+    # wrapper picks the Pallas kernel when shapes allow, einsum otherwise
+    out = flash.causal_attention(qh, kh, vh)
+    # head-sharded/full-seq -> seq-sharded/all-heads
+    return jax.lax.all_to_all(
+        out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
+    )
+
+
+def ulysses_causal_attention(
+    q: jax.Array,  # (B, T, H, hd) global
+    k: jax.Array,  # (B, T, KV, hd)
+    v: jax.Array,
+    mesh: Optional[Mesh],
+    *,
+    attn_pdrop: float = 0.0,
+    dropout_key: Optional[jax.Array] = None,
+    deterministic: bool = True,
+    kv_offset: int | jax.Array = 0,
+) -> jax.Array:
+    """All-to-all sequence-parallel causal attention (oracle fallback when
+    the strategy doesn't apply)."""
+    b, t, h, hd = q.shape
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    usable = (
+        mesh is not None
+        and sp > 1
+        and t == k.shape[1]
+        and (deterministic or attn_pdrop == 0.0)
+        and isinstance(kv_offset, int)
+        and kv_offset == 0
+        and t % sp == 0
+        and h % sp == 0
+    )
+    if not usable:
+        return attn_ops.causal_attention(
+            q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
+            deterministic=deterministic, kv_offset=kv_offset,
+        )
+    kv = k.shape[2]
+    k = attn_ops.repeat_kv(k, h // kv)
+    v = attn_ops.repeat_kv(v, h // kv)
+    spec = P(BATCH_AXES, "sp", None, None)
+    fn = jax.shard_map(
+        partial(_ulysses_shard, axis_name="sp"),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    return fn(q, k, v)

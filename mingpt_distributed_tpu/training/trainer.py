@@ -163,6 +163,11 @@ class GPTTrainer:
         )
 
         self.snapshot_path = config.snapshot_path or ckpt_lib.DEFAULT_SNAPSHOT_PATH
+        # backend: .msgpack = single-blob (reference contract, host gather);
+        # anything else = Orbax directory (sharded, collective, no gather)
+        self.ckpt_backend = (
+            "msgpack" if self.snapshot_path.endswith(".msgpack") else "orbax"
+        )
         self.base_rng = jax.random.key(config.seed)
 
         # --- abstract state + shardings, then materialise on-mesh ---------
@@ -172,11 +177,21 @@ class GPTTrainer:
         self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
         self.repl = NamedSharding(self.mesh, P())
 
-        restored = ckpt_lib.load_snapshot(
-            self.snapshot_path,
-            state_shape["params"],
-            state_shape["opt_state"],
-        )
+        if self.ckpt_backend == "orbax":
+            from mingpt_distributed_tpu.training import checkpoint_orbax
+
+            restored = checkpoint_orbax.load_snapshot(
+                self.snapshot_path,
+                state_shape["params"],
+                state_shape["opt_state"],
+                shardings=self.shardings,
+            )
+        else:
+            restored = ckpt_lib.load_snapshot(
+                self.snapshot_path,
+                state_shape["params"],
+                state_shape["opt_state"],
+            )
         if restored is None:
             if self.is_writer:
                 print("Snapshot not found. Training model from scratch")
@@ -189,8 +204,12 @@ class GPTTrainer:
                 "step": jnp.asarray(restored.step, dtype=jnp.int32),
             }
             self.state = jax.tree.map(
-                lambda x, s: jax.make_array_from_callback(
-                    np.shape(x), s, lambda idx: np.asarray(x)[idx]
+                lambda x, s: (
+                    x  # orbax restores already placed with the right sharding
+                    if getattr(x, "sharding", None) == s
+                    else jax.make_array_from_callback(
+                        np.shape(x), s, lambda idx: np.asarray(x)[idx]
+                    )
                 ),
                 host_state,
                 self.shardings,
@@ -357,22 +376,7 @@ class GPTTrainer:
         the state is first gathered to every host with a collective
         (process_allgather); only process 0 then writes.
         """
-        if self.process_count > 1:
-            from jax.experimental import multihost_utils
-
-            params = multihost_utils.process_allgather(
-                self.state["params"], tiled=True
-            )
-            opt_state = multihost_utils.process_allgather(
-                self.state["opt_state"], tiled=True
-            )
-        else:
-            params, opt_state = self.state["params"], self.state["opt_state"]
-        if not self.is_writer:
-            return
-        snap = ckpt_lib.Snapshot(
-            params=params,
-            opt_state=opt_state,
+        common = dict(
             step=self.step,
             epoch=epoch,
             prng=np.asarray(jax.random.key_data(self.base_rng)),
@@ -381,5 +385,39 @@ class GPTTrainer:
                 self.experiment_config.to_dict() if self.experiment_config else {}
             ),
         )
-        ckpt_lib.save_snapshot(self.snapshot_path, snap)
-        print(f"Snapshot saved to {self.snapshot_path} (epoch {epoch}, step {self.step})")
+        if self.ckpt_backend == "orbax":
+            # collective sharded save: every process writes its shards
+            from mingpt_distributed_tpu.training import checkpoint_orbax
+
+            checkpoint_orbax.save_snapshot(
+                self.snapshot_path,
+                ckpt_lib.Snapshot(
+                    params=self.state["params"],
+                    opt_state=self.state["opt_state"],
+                    **common,
+                ),
+            )
+        else:
+            if self.process_count > 1:
+                from jax.experimental import multihost_utils
+
+                params = multihost_utils.process_allgather(
+                    self.state["params"], tiled=True
+                )
+                opt_state = multihost_utils.process_allgather(
+                    self.state["opt_state"], tiled=True
+                )
+            else:
+                params = self.state["params"]
+                opt_state = self.state["opt_state"]
+            if not self.is_writer:
+                return
+            ckpt_lib.save_snapshot(
+                self.snapshot_path,
+                ckpt_lib.Snapshot(params=params, opt_state=opt_state, **common),
+            )
+        if self.is_writer:
+            print(
+                f"Snapshot saved to {self.snapshot_path} "
+                f"(epoch {epoch}, step {self.step}, {self.ckpt_backend})"
+            )
