@@ -267,6 +267,10 @@ class TrainerConfig:
     eval_batches: Optional[int] = None  # cap eval batches; None = full pass
     metrics_jsonl: Optional[str] = None  # JSONL metrics sink (§5.5 upgrade)
     prefetch: int = 2  # background batch-prefetch depth; 0 disables
+    # debug aids (SURVEY §5.2 — the reference shipped a real checkpoint race
+    # and had no sanitizers): jax_debug_nans traps the first NaN/Inf inside
+    # the compiled step instead of letting training silently diverge.
+    debug_nans: bool = False
     mesh: MeshConfig = field(default_factory=MeshConfig)
     profile_dir: Optional[str] = None   # jax.profiler trace output
     profile_steps: Tuple[int, int] = (10, 20)

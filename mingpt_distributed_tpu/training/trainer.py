@@ -124,6 +124,8 @@ class GPTTrainer:
     ):
         self.config = config
         self.gpt_config = gpt_config
+        if config.debug_nans:
+            jax.config.update("jax_debug_nans", True)
         self.mesh = mesh if mesh is not None else mesh_lib.make_mesh(config.mesh)
         self.process_index = jax.process_index()
         self.process_count = jax.process_count()
