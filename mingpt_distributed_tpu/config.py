@@ -114,6 +114,7 @@ class GPTConfig:
     rmsnorm: bool = False
     n_kv_head: Optional[int] = None  # grouped-query attention; None = n_head
     ffn_mult: float = 4.0  # MLP expansion factor (reference hardcodes 4x)
+    norm_eps: float = 1e-5  # LayerNorm/RMSNorm epsilon
 
     @classmethod
     def make(cls, **kwargs: Any) -> "GPTConfig":

@@ -148,8 +148,8 @@ def _attention_dispatch(cfg: GPTConfig, mesh=None):
 
 def _norm(x, scale, bias, cfg: GPTConfig):
     if cfg.rmsnorm:
-        return L.rms_norm(x, scale)
-    return L.layer_norm(x, scale, bias)
+        return L.rms_norm(x, scale, eps=cfg.norm_eps)
+    return L.layer_norm(x, scale, bias, eps=cfg.norm_eps)
 
 
 def _block(

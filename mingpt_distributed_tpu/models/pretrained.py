@@ -116,6 +116,57 @@ def load_hf_state_dict(sd: Mapping[str, Any], cfg: GPTConfig) -> Params:
     return params
 
 
+def load_hf_llama_state_dict(sd: Mapping[str, Any], cfg: GPTConfig) -> Params:
+    """Map a HF LlamaForCausalLM state dict onto our pytree.
+
+    Llama uses nn.Linear, whose weight is stored (out_features, in_features)
+    — the OPPOSITE of GPT-2's Conv1D — so every projection transposes here
+    (and none do in load_hf_state_dict). RMSNorm scales and the embedding
+    map straight across; rotary tables are computed, not stored.
+    """
+    if not (cfg.rope and cfg.swiglu and cfg.rmsnorm):
+        raise ValueError("llama mapping expects rope+swiglu+rmsnorm config")
+    prefix = "model." if any(k.startswith("model.") for k in sd) else ""
+    nl = cfg.n_layer
+
+    wte = _get(sd, f"{prefix}embed_tokens.weight")
+    if wte.shape != (cfg.vocab_size, cfg.n_embd):
+        raise ValueError(
+            f"embed_tokens {wte.shape} != ({cfg.vocab_size}, {cfg.n_embd})"
+        )
+
+    def stack_t(fmt: str) -> np.ndarray:
+        # (out, in) -> (in, out), stacked over layers
+        return np.stack(
+            [_get(sd, prefix + fmt.format(i)).T for i in range(nl)]
+        )
+
+    def stack(fmt: str) -> np.ndarray:
+        return np.stack([_get(sd, prefix + fmt.format(i)) for i in range(nl)])
+
+    blocks = {
+        "ln1_scale": stack("layers.{}.input_layernorm.weight"),
+        "ln2_scale": stack("layers.{}.post_attention_layernorm.weight"),
+        "wq": stack_t("layers.{}.self_attn.q_proj.weight"),
+        "wk": stack_t("layers.{}.self_attn.k_proj.weight"),
+        "wv": stack_t("layers.{}.self_attn.v_proj.weight"),
+        "wo": stack_t("layers.{}.self_attn.o_proj.weight"),
+        "w_gate": stack_t("layers.{}.mlp.gate_proj.weight"),
+        "w_up": stack_t("layers.{}.mlp.up_proj.weight"),
+        "w_down": stack_t("layers.{}.mlp.down_proj.weight"),
+    }
+    params: Params = {
+        "wte": np.asarray(wte, dtype=np.float32),
+        "blocks": {k: np.asarray(v, dtype=np.float32) for k, v in blocks.items()},
+        "lnf_scale": np.asarray(_get(sd, f"{prefix}norm.weight"), np.float32),
+    }
+    if not cfg.tie_weights:
+        params["head"] = np.asarray(
+            _get(sd, "lm_head.weight"), np.float32
+        ).T.copy()
+    return params
+
+
 def from_pretrained(
     model_type: str = "gpt2", **config_overrides: Any
 ) -> Tuple[GPTConfig, Params]:

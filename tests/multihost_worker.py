@@ -1,0 +1,77 @@
+"""Worker script for the 2-process multi-host integration test.
+
+Run by tests/test_multihost.py in two subprocesses. Exercises the REAL
+multi-host code paths that single-process tests can't: the
+COORDINATOR_ADDRESS env contract (parallel/distributed.py — the torchrun-env
+analogue), per-process data sharding (ShardedBatchIterator), local-shard ->
+global-array assembly (make_array_from_process_local_data), the
+process_allgather snapshot gather, and single-global-writer semantics.
+
+Prints one final line: MULTIHOST_RESULT <json>.
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    snapshot_path = sys.argv[1]
+    max_steps = int(sys.argv[2])
+
+    import jax
+
+    from mingpt_distributed_tpu.parallel import distributed
+
+    distributed.initialize()  # reads COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID
+    assert jax.process_count() == 2, jax.process_count()
+
+    from mingpt_distributed_tpu.config import (
+        DataConfig,
+        GPTConfig,
+        MeshConfig,
+        OptimizerConfig,
+        TrainerConfig,
+    )
+    from mingpt_distributed_tpu.data.char_dataset import CharDataset
+    from mingpt_distributed_tpu.training.trainer import GPTTrainer
+
+    corpus = (
+        "multi host training shards the batch across processes and gathers "
+        "snapshots from every host before writing. " * 30
+    )
+    ds = CharDataset(
+        DataConfig(path="<inline>", block_size=16, train_split=0.9), text=corpus
+    )
+    train, test = ds.split()
+    gcfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=ds.vocab_size,
+        block_size=16, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="float32",
+    )
+    tcfg = TrainerConfig.make(
+        max_epochs=1, batch_size=8, grad_norm_clip=1.0, save_every=100,
+        log_every=1000, seed=7, max_steps=max_steps,
+        snapshot_path=snapshot_path,
+        mesh=MeshConfig(dp=2, fsdp=1, tp=1, sp=1),
+        prefetch=0,
+    )
+    tr = GPTTrainer(tcfg, gcfg, OptimizerConfig(learning_rate=1e-2), train, test)
+    start_step = tr.step
+    tr.train()
+    loss = float(jax.device_get(
+        tr._eval_step(tr.state, tr._put_batch(next(tr.test_iter.epoch_batches())))
+    ))
+    print("MULTIHOST_RESULT " + json.dumps({
+        "process": jax.process_index(),
+        "start_step": start_step,
+        "end_step": tr.step,
+        "eval_loss": loss,
+        "wrote_snapshot": os.path.exists(snapshot_path),
+    }), flush=True)
+    distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

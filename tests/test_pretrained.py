@@ -124,3 +124,53 @@ def test_gpt_class_facade(hf_small, capsys):
     out = m.generate([1, 2, 3], 5)
     assert out.shape == (1, 8)
     assert m.num_params > 0
+
+
+@pytest.fixture(scope="module")
+def hf_llama():
+    """Small random LlamaForCausalLM built locally (no download)."""
+    cfg = transformers.LlamaConfig(
+        vocab_size=97, hidden_size=48, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        attention_dropout=0.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    m = transformers.LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def llama_cfg():
+    return GPTConfig.make(
+        n_layer=2, n_head=4, n_embd=48, vocab_size=97, block_size=64,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+        rope=True, swiglu=True, rmsnorm=True, n_kv_head=2,
+        ffn_mult=128 / 48, tie_weights=False, norm_eps=1e-5,
+    )
+
+
+def test_llama_logit_parity_with_torch(hf_llama):
+    from mingpt_distributed_tpu.models.pretrained import load_hf_llama_state_dict
+    cfg = llama_cfg()
+    params = load_hf_llama_state_dict(hf_llama.state_dict(), cfg)
+    rng = np.random.default_rng(1)
+    tokens = rng.integers(0, 97, (2, 32))
+    with torch.no_grad():
+        want = hf_llama(torch.tensor(tokens)).logits.numpy()
+    got, _ = gpt.forward(params, tokens.astype(np.int32), cfg)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=5e-4, atol=5e-4)
+
+
+def test_llama_generation_parity_greedy(hf_llama):
+    from mingpt_distributed_tpu.models.pretrained import load_hf_llama_state_dict
+    cfg = llama_cfg()
+    params = load_hf_llama_state_dict(hf_llama.state_dict(), cfg)
+    prompt = np.array([[5, 17, 3, 9]])
+    with torch.no_grad():
+        want = hf_llama.generate(
+            torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+            pad_token_id=0,
+        ).numpy()
+    got = gen.generate(params, cfg, prompt.astype(np.int32), 8)
+    np.testing.assert_array_equal(np.asarray(got), want)
