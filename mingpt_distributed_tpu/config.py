@@ -211,12 +211,19 @@ class OptimizerConfig:
 
 @dataclass
 class DataConfig:
-    """Reference DataConfig (char_dataset.py:12-17)."""
+    """Reference DataConfig (char_dataset.py:12-17) + tokenizer selection."""
 
     path: str = ""
     block_size: int = 128
     train_split: float = 0.9
     truncate: float = 1.0
+    # --- extensions ------------------------------------------------------
+    # "char" = reference behavior; "bpe" = byte-level BPE (data/bpe.py):
+    # trained on the corpus to bpe_vocab_size, or loaded from bpe_path
+    # (a tokenizer saved with BPETokenizer.save, or trained earlier).
+    tokenizer: str = "char"
+    bpe_vocab_size: int = 512
+    bpe_path: Optional[str] = None
 
     @classmethod
     def make(cls, **kwargs: Any) -> "DataConfig":
@@ -225,6 +232,8 @@ class DataConfig:
             raise ConfigError(f"train_split={cfg.train_split} outside (0, 1]")
         if not (0.0 < cfg.truncate <= 1.0):
             raise ConfigError(f"truncate={cfg.truncate} outside (0, 1]")
+        if cfg.tokenizer not in ("char", "bpe"):
+            raise ConfigError(f"unknown tokenizer {cfg.tokenizer!r}")
         return cfg
 
 

@@ -1,0 +1,198 @@
+"""Byte-level BPE tokenizer — the upstream-minGPT ``bpe.py`` capability.
+
+Upstream minGPT ships a GPT-2 BPE encoder that the reference fork dropped
+(its README still advertises it — SURVEY §0's missing-files caveat). Without
+it, ``GPT.from_pretrained('gpt2')`` can run but not talk. This module
+restores the capability two ways:
+
+* ``BPETokenizer.from_gpt2_files(encoder_json, vocab_bpe)`` loads the
+  OpenAI vocabulary/merges from local files (they cannot be fetched in a
+  zero-egress environment, but users with the standard ``encoder.json`` +
+  ``vocab.bpe`` get exact GPT-2 tokenization: byte->unicode table, merge
+  ranks, and the GPT-2 contraction/word/number split pattern);
+* ``BPETokenizer.train(text, vocab_size)`` learns merges from a corpus, so
+  BPE-level training works fully offline (``data_config.tokenizer: bpe``).
+
+Implementation is the standard byte-level BPE: tokens are bytes mapped to
+printable unicode points; merges apply greedily by learned rank.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+import regex as re
+
+# GPT-2's pre-tokenization pattern: contractions, letter runs, number runs,
+# punctuation runs, and whitespace handling (public lore).
+GPT2_SPLIT_PATTERN = (
+    r"'s|'t|'re|'ve|'m|'ll|'d| ?\p{L}+| ?\p{N}+| ?[^\s\p{L}\p{N}]+|\s+(?!\S)|\s+"
+)
+
+
+def bytes_to_unicode() -> Dict[int, str]:
+    """The GPT-2 byte -> printable-unicode bijection: printable ASCII and
+    latin-1 map to themselves; the rest shift into 256+ codepoints."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("\xa1"), ord("\xac") + 1))
+        + list(range(ord("\xae"), ord("\xff") + 1))
+    )
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+class BPETokenizer:
+    """Byte-level BPE with GPT-2-compatible loading and offline training."""
+
+    def __init__(
+        self,
+        encoder: Dict[str, int],
+        merge_ranks: Dict[Tuple[str, str], int],
+        split_pattern: str = GPT2_SPLIT_PATTERN,
+    ):
+        self.encoder = dict(encoder)
+        self.decoder = {v: k for k, v in self.encoder.items()}
+        self.merge_ranks = dict(merge_ranks)
+        self.pattern = re.compile(split_pattern)
+        self.byte_encoder = bytes_to_unicode()
+        self.byte_decoder = {v: k for k, v in self.byte_encoder.items()}
+        self._cache: Dict[str, List[str]] = {}
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.encoder)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_gpt2_files(cls, encoder_json: str, vocab_bpe: str) -> "BPETokenizer":
+        """Exact GPT-2 tokenizer from the standard OpenAI artifacts."""
+        with open(encoder_json) as f:
+            encoder = json.load(f)
+        with open(vocab_bpe, encoding="utf-8") as f:
+            lines = f.read().split("\n")
+        merges = [tuple(l.split()) for l in lines if l and not l.startswith("#")]
+        ranks = {m: i for i, m in enumerate(m for m in merges if len(m) == 2)}
+        return cls(encoder, ranks)
+
+    @classmethod
+    def train(
+        cls, text: str, vocab_size: int, split_pattern: str = GPT2_SPLIT_PATTERN
+    ) -> "BPETokenizer":
+        """Learn merges from a corpus (offline path). vocab_size >= 256."""
+        if vocab_size < 256:
+            raise ValueError("byte-level BPE needs vocab_size >= 256")
+        byte_enc = bytes_to_unicode()
+        # word -> frequency, each word as a tuple of unicode-mapped bytes
+        words: Dict[Tuple[str, ...], int] = {}
+        for piece in re.findall(split_pattern, text):
+            w = tuple(byte_enc[b] for b in piece.encode("utf-8"))
+            if w:
+                words[w] = words.get(w, 0) + 1
+
+        encoder = {ch: i for i, ch in enumerate(byte_enc[b] for b in range(256))}
+        ranks: Dict[Tuple[str, str], int] = {}
+        while len(encoder) < vocab_size:
+            pair_counts: Dict[Tuple[str, str], int] = {}
+            for w, c in words.items():
+                for a, b in zip(w, w[1:]):
+                    pair_counts[(a, b)] = pair_counts.get((a, b), 0) + c
+            if not pair_counts:
+                break
+            best = max(pair_counts, key=lambda p: (pair_counts[p], p))
+            if pair_counts[best] < 2:
+                break
+            ranks[best] = len(ranks)
+            merged = best[0] + best[1]
+            encoder[merged] = len(encoder)
+            new_words: Dict[Tuple[str, ...], int] = {}
+            for w, c in words.items():
+                out: List[str] = []
+                i = 0
+                while i < len(w):
+                    if i + 1 < len(w) and (w[i], w[i + 1]) == best:
+                        out.append(merged)
+                        i += 2
+                    else:
+                        out.append(w[i])
+                        i += 1
+                t = tuple(out)
+                new_words[t] = new_words.get(t, 0) + c
+            words = new_words
+        return cls(encoder, ranks, split_pattern)
+
+    # ------------------------------------------------------------------
+    # encode / decode
+    # ------------------------------------------------------------------
+    def _bpe(self, token: str) -> List[str]:
+        """Apply merges to one pre-token (unicode-mapped byte string)."""
+        cached = self._cache.get(token)
+        if cached is not None:
+            return cached
+        parts = list(token)
+        while len(parts) > 1:
+            pairs = {(a, b) for a, b in zip(parts, parts[1:])}
+            best = min(
+                pairs, key=lambda p: self.merge_ranks.get(p, float("inf"))
+            )
+            if best not in self.merge_ranks:
+                break
+            merged = best[0] + best[1]
+            out: List[str] = []
+            i = 0
+            while i < len(parts):
+                if i + 1 < len(parts) and (parts[i], parts[i + 1]) == best:
+                    out.append(merged)
+                    i += 2
+                else:
+                    out.append(parts[i])
+                    i += 1
+            parts = out
+        self._cache[token] = parts
+        return parts
+
+    def encode(self, text: str) -> np.ndarray:
+        ids: List[int] = []
+        for piece in self.pattern.findall(text):
+            mapped = "".join(self.byte_encoder[b] for b in piece.encode("utf-8"))
+            for part in self._bpe(mapped):
+                ids.append(self.encoder[part])
+        return np.array(ids, dtype=np.int32)
+
+    def decode(self, ids: Iterable[int]) -> str:
+        text = "".join(self.decoder[int(i)] for i in np.asarray(ids).reshape(-1))
+        raw = bytes(self.byte_decoder[ch] for ch in text)
+        return raw.decode("utf-8", errors="replace")
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {
+                    "encoder": self.encoder,
+                    "merges": [list(k) for k in sorted(
+                        self.merge_ranks, key=self.merge_ranks.get
+                    )],
+                    "pattern": self.pattern.pattern,
+                },
+                f,
+            )
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        with open(path) as f:
+            blob = json.load(f)
+        ranks = {tuple(m): i for i, m in enumerate(blob["merges"])}
+        return cls(blob["encoder"], ranks, blob["pattern"])

@@ -1,0 +1,77 @@
+"""BPE-token dataset — the CharDataset shape over subword tokens.
+
+Same public surface as CharDataset (data, vocab_size, block_size, encode/
+decode, split() -> contiguous views), so the trainer and both entry points
+work unchanged with ``data_config.tokenizer: bpe``. The tokenizer either
+loads from ``bpe_path`` (a saved BPETokenizer, e.g. one trained earlier or
+converted from GPT-2's encoder.json/vocab.bpe) or is trained on the corpus
+to ``bpe_vocab_size`` and cached next to the snapshot-style artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import fsspec
+import numpy as np
+
+from mingpt_distributed_tpu.config import DataConfig
+from mingpt_distributed_tpu.data.bpe import BPETokenizer
+from mingpt_distributed_tpu.data.char_dataset import CharView
+
+
+class TokenDataset:
+    """Corpus of BPE tokens with next-token (x, y) windows."""
+
+    def __init__(
+        self,
+        config: DataConfig,
+        text: Optional[str] = None,
+        tokenizer: Optional[BPETokenizer] = None,
+    ):
+        self.config = config
+        if text is None:
+            with fsspec.open(config.path, "r", encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        text = text[: int(len(text) * config.truncate)]
+        if tokenizer is not None:
+            self.tokenizer = tokenizer
+        elif config.bpe_path:
+            self.tokenizer = BPETokenizer.load(config.bpe_path)
+        else:
+            self.tokenizer = BPETokenizer.train(text, config.bpe_vocab_size)
+        self.vocab_size = self.tokenizer.vocab_size
+        self.block_size = config.block_size
+        self.data = self.tokenizer.encode(text)
+        if len(self.data) <= self.block_size:
+            raise ValueError(
+                f"corpus ({len(self.data)} tokens) must exceed block_size "
+                f"({self.block_size})"
+            )
+
+    def __len__(self) -> int:
+        return len(self.data) - self.block_size
+
+    def __getitem__(self, idx: int) -> Tuple[np.ndarray, np.ndarray]:
+        chunk = self.data[idx : idx + self.block_size + 1]
+        return chunk[:-1].astype(np.int32), chunk[1:].astype(np.int32)
+
+    def encode(self, text: str) -> np.ndarray:
+        return self.tokenizer.encode(text)
+
+    def decode(self, ids) -> str:
+        return self.tokenizer.decode(ids)
+
+    def split(self, train_split: Optional[float] = None) -> Tuple[CharView, CharView]:
+        frac = self.config.train_split if train_split is None else train_split
+        cut = int(len(self.data) * frac)
+        return CharView(self, 0, cut), CharView(self, cut, len(self.data))
+
+
+def make_dataset(config: DataConfig, text: Optional[str] = None):
+    """Dataset factory keyed by data_config.tokenizer."""
+    if config.tokenizer == "bpe":
+        return TokenDataset(config, text=text)
+    from mingpt_distributed_tpu.data.char_dataset import CharDataset
+
+    return CharDataset(config, text=text)
