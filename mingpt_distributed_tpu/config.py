@@ -52,7 +52,8 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
                           rope=True, swiglu=True, rmsnorm=True, tie_weights=False),
     "llama-3-8b":    dict(n_layer=32, n_head=32, n_embd=4096, n_kv_head=8,
                           rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
-                          vocab_size=128256, block_size=8192, ffn_mult=3.5),
+                          vocab_size=128256, block_size=8192, ffn_mult=3.5,
+                          rope_theta=500000.0),  # Llama 3 base, not the 1e4 default
 }
 
 

@@ -25,17 +25,51 @@ class PrefetchIterator:
         self._source = source
         self._queue: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._err: BaseException | None = None
+        self._stopped = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
     def _run(self) -> None:
         try:
             for item in self._source:
-                self._queue.put(item)
+                while not self._stopped.is_set():
+                    try:
+                        self._queue.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._stopped.is_set():
+                    return
         except BaseException as e:  # surfaced on the consumer side
             self._err = e
         finally:
-            self._queue.put(self._DONE)
+            # the DONE sentinel must be delivered or the consumer blocks
+            # forever at source exhaustion — same stopped-aware retry loop
+            # as items (only a close() may skip it; close() drains anyway)
+            while not self._stopped.is_set():
+                try:
+                    self._queue.put(self._DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    def close(self) -> None:
+        """Stop and join the producer thread.
+
+        Call before mutating any state the source generator also touches
+        (e.g. the trainer's IteratorState on an early max_steps stop): the
+        producer advances the source *ahead* of consumption, so a snapshot
+        taken while it still runs could persist a data position beyond what
+        was trained on — resume would then silently skip batches.
+        """
+        self._stopped.set()
+        # drain so a producer blocked on put() observes the stop promptly
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=10.0)
 
     def __iter__(self) -> "PrefetchIterator":
         return self

@@ -16,10 +16,15 @@ to translate). Here decoding is two compiled programs:
      step one-token attention against the cache (static shapes throughout,
      cache updated in place via dynamic_update_slice).
 
-Context-window semantics: the prompt is cropped host-side to the last
-``block_size - max_new_tokens`` tokens so prompt+generation fit the cache
-(the reference instead re-crops to the last block_size tokens every step; the
-two coincide whenever generation fits the window, the common case).
+Context-window semantics match the reference exactly: generation is
+**unbounded** — when prompt+generation no longer fit ``block_size``, decoding
+switches to a sliding-window program that re-crops to the last ``block_size``
+tokens every step (/root/reference/mingpt/model.py:336-337). The window slide
+re-positions every token (learned absolute positions shift), so cached K/V
+written at the old positions would be stale — the sliding program therefore
+re-forwards the full (static-shape) window per step, exactly the reference's
+O(T·forward) semantics, still as one compiled ``lax.scan``. The KV-cached
+fast path handles the common fits-the-window case.
 """
 
 from __future__ import annotations
@@ -166,6 +171,46 @@ def _generate_jit(
     return jnp.concatenate([idx, new_tokens], axis=1)
 
 
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "max_new_tokens", "temperature", "do_sample", "top_k"),
+)
+def _generate_sliding_jit(
+    params, idx, rng, *, cfg: GPTConfig, max_new_tokens: int,
+    temperature: float, do_sample: bool, top_k: Optional[int],
+):
+    """Reference-semantics sliding-window decode (model.py:336-337): every
+    step forwards the last ``block_size`` tokens with positions 0..len-1.
+    Static shapes: the window buffer is always (B, block_size), left-aligned;
+    causal masking makes the garbage beyond ``length`` invisible to the
+    read-out position. Returns only the (B, max_new_tokens) new tokens."""
+    b, t0 = idx.shape  # t0 <= block_size (caller crops)
+    bs = cfg.block_size
+    window = jnp.zeros((b, bs), jnp.int32)
+    window = jax.lax.dynamic_update_slice(window, idx, (0, 0))
+    step_keys = jax.random.split(rng, max_new_tokens)
+
+    def step(carry, step_rng):
+        window, length = carry
+        logits_all, _ = gpt.forward(params, window, cfg)
+        logits = jax.lax.dynamic_slice_in_dim(
+            logits_all, length - 1, 1, axis=1
+        )[:, 0]
+        nxt = _select_next(
+            logits, step_rng, temperature, do_sample, top_k
+        ).astype(jnp.int32)
+        full = length >= bs
+        base = jnp.where(full, jnp.roll(window, -1, axis=1), window)
+        pos = jnp.where(full, bs - 1, length)
+        window = jax.lax.dynamic_update_slice(base, nxt[:, None], (0, pos))
+        return (window, jnp.minimum(length + 1, bs)), nxt
+
+    (_, _), toks = jax.lax.scan(
+        step, (window, jnp.asarray(t0, jnp.int32)), step_keys
+    )
+    return jnp.moveaxis(toks, 0, 1)
+
+
 def generate(
     params: gpt.Params,
     cfg: GPTConfig,
@@ -178,22 +223,30 @@ def generate(
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``idx`` (B, T0).
 
-    Keeps the reference's signature and semantics (model.py:323-328); one
-    compiled program per (prompt_len, max_new_tokens) pair thereafter.
+    Keeps the reference's signature and semantics (model.py:323-328),
+    including unbounded generation past the context window; one compiled
+    program per (prompt_len, max_new_tokens) pair thereafter.
     """
     idx = jnp.asarray(idx, dtype=jnp.int32)
     if idx.ndim == 1:
         idx = idx[None]
     if max_new_tokens < 1:
         return idx
-    # crop so prompt + generation fit the cache (see module docstring)
-    keep = max(1, cfg.block_size - max_new_tokens)
-    if idx.shape[1] > keep:
-        idx = idx[:, -keep:]
     if rng is None:
         rng = jax.random.key(0)
-    return _generate_jit(
-        params, idx, rng, cfg=cfg, max_new_tokens=max_new_tokens,
-        temperature=float(temperature), do_sample=bool(do_sample),
+    if idx.shape[1] + max_new_tokens <= cfg.block_size:
+        # fits the window: KV-cached fast path (positions never slide)
+        return _generate_jit(
+            params, idx, rng, cfg=cfg, max_new_tokens=max_new_tokens,
+            temperature=float(temperature), do_sample=bool(do_sample),
+            top_k=None if top_k is None else int(top_k),
+        )
+    # overflow: reference-exact sliding window over the last block_size
+    # tokens; the full prompt still heads the returned sequence
+    new = _generate_sliding_jit(
+        params, idx[:, -cfg.block_size:], rng, cfg=cfg,
+        max_new_tokens=max_new_tokens, temperature=float(temperature),
+        do_sample=bool(do_sample),
         top_k=None if top_k is None else int(top_k),
     )
+    return jnp.concatenate([idx, new], axis=1)

@@ -12,11 +12,20 @@ Shapes follow ops.attention.causal_attention: q (B, T, H, hd), k/v
 (B, S, KV, hd) with GQA handled by broadcasting outside the kernel (autodiff
 then sums dk/dv over the query-head group for free).
 
-Forward: grid (B*H, T/BQ); each cell loads its q block, loops over k blocks
-up to the diagonal (causal), maintaining running max m, denominator l and
-accumulator acc; also emits the log-sum-exp per row for the backward.
-Backward: two kernels (dq over q blocks; dk/dv over k blocks) recompute the
-probabilities from the saved LSE — no stored attention matrix anywhere.
+Tiling: every kernel streams K/V (or Q, for dk/dv) **block-by-block through
+the grid** — the per-cell VMEM footprint is O(block·hd + block²) regardless
+of sequence length, so the shipped llama presets (block_size 8192) fit VMEM.
+The sequential innermost grid dimension carries the online-softmax state
+(running max m, denominator l, accumulator acc) in VMEM scratch across k
+blocks; causality is enforced at block granularity by skipping cells above
+the diagonal, whose index maps clamp to the diagonal so Pallas's revisit
+optimisation never re-DMAs a block that won't be used.
+
+Forward: grid (B*H, T/B, T/B) with the k-block index innermost; emits the
+log-sum-exp per row for the backward.
+Backward: two kernels — dq streams K/V blocks per q block; dk/dv streams
+Q/dO blocks per k block — both recomputing probabilities from the saved LSE.
+No stored attention matrix anywhere.
 
 Falls back to the einsum oracle when the shape/config doesn't fit the kernel
 (attention dropout on, decode-time cross lengths, T not a multiple of the
@@ -33,6 +42,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from mingpt_distributed_tpu.ops import attention as attn_ops
 
@@ -58,63 +68,85 @@ def _block_sizes(t: int) -> Optional[int]:
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block, t):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, block):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (BQ, hd)
-    hd = q.shape[-1]
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        kblk = k_ref[0, pl.ds(kb * block, block), :]
-        vblk = v_ref[0, pl.ds(kb * block, block), :]
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    @pl.when(kj <= qi)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale  # (BQ, hd)
+        kblk = k_ref[0]  # (BK, hd)
+        vblk = v_ref[0]
         s = jax.lax.dot_general(
             q, kblk.astype(jnp.float32),
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # (BQ, BK)
-        q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-        k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        q_pos = qi * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0)
+        k_pos = kj * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
 
+        m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
             p.astype(vblk.dtype), vblk,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return m_new, l, acc
 
-    m0 = jnp.full((block, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block, 1), jnp.float32)
-    acc0 = jnp.zeros((block, hd), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, qi + 1, body, (m0, l0, acc0))
-
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+        lse_ref[0] = m + jnp.log(l)  # (BQ, 1)
 
 
 def _flash_fwd(q, k, v, scale, block):
     """q/k/v: (BH, T, hd) -> (out (BH, T, hd), lse (BH, T))."""
     bh, t, hd = q.shape
-    grid = (bh, t // block)
+    nb = t // block
+    grid = (bh, nb, nb)
+    # masked (above-diagonal) cells clamp their k index to the diagonal so
+    # the pipeline never fetches a block the kernel will skip
+    kv_spec = pl.BlockSpec(
+        (1, block, hd), lambda b, i, j: (b, jnp.minimum(j, i), 0))
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, block=block, t=t),
+        functools.partial(_fwd_kernel, scale=scale, block=block),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0)),
+            kv_spec,
+            kv_spec,
         ],
         out_specs=[
-            pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0)),
+            # (BH, T, 1) rather than (BH, T): Mosaic requires the last two
+            # block dims to be (8k, 128k) or equal to the array dims — a
+            # trailing singleton satisfies that where a (1, block) tile can't
+            pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, hd), q.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, 1), jnp.float32),
+            pltpu.VMEM((block, hd), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -127,23 +159,31 @@ def _flash_fwd(q, k, v, scale, block):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               *, scale, block, t):
+               dq_scr, *, scale, block):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]
-    delta = delta_ref[0][:, None]
-    hd = q.shape[-1]
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
 
-    def body(kb, dq):
-        kblk = k_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
-        vblk = v_ref[0, pl.ds(kb * block, block), :].astype(jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    @pl.when(kj <= qi)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # (BQ, 1)
+        delta = delta_ref[0]
+        kblk = k_ref[0].astype(jnp.float32)
+        vblk = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q * scale, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        q_pos = qi * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-        k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        q_pos = qi * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0)
+        k_pos = kj * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
@@ -151,40 +191,47 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
+        dq_scr[...] += jax.lax.dot_general(
             ds, kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(
-        0, qi + 1, body, jnp.zeros((block, hd), jnp.float32)
-    )
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, block, t):
-    kb = pl.program_id(1)
-    nq = t // block
-    kblk = k_ref[0].astype(jnp.float32)  # (BK, hd)
-    vblk = v_ref[0].astype(jnp.float32)
-    hd = kblk.shape[-1]
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block):
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
 
-    def body(qb, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qb * block, block), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qb * block, block)][:, None]
-        delta = delta_ref[0, pl.ds(qb * block, block)][:, None]
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # only q blocks at or below the diagonal see this k block
+    @pl.when(qi >= kj)
+    def _compute():
+        kblk = k_ref[0].astype(jnp.float32)  # (BK, hd)
+        vblk = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)  # (BQ, hd)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # (BQ, 1)
+        delta = delta_ref[0]
         s = jax.lax.dot_general(
             q * scale, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        q_pos = qb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 0)
-        k_pos = kb * block + jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+        q_pos = qi * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 0)
+        k_pos = kj * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         p = jnp.exp(s - lse)  # (BQ, BK)
-        dv = dv + jax.lax.dot_general(
+        dv_scr[...] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -193,46 +240,60 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_scr[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        return dk, dv
 
-    # only q blocks at or below the diagonal see this k block
-    dk0 = jnp.zeros((block, hd), jnp.float32)
-    dv0 = jnp.zeros((block, hd), jnp.float32)
-    dk, dv = jax.lax.fori_loop(kb, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_bwd(q, k, v, out, lse, do, scale, block):
     bh, t, hd = q.shape
-    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
-    grid = (bh, t // block)
-    qspec_blk = pl.BlockSpec((1, block, hd), lambda b, i: (b, i, 0))
-    qspec_full = pl.BlockSpec((1, t, hd), lambda b, i: (b, 0, 0))
-    vec_blk = pl.BlockSpec((1, block), lambda b, i: (b, i))
-    vec_full = pl.BlockSpec((1, t), lambda b, i: (b, 0))
+    delta = jnp.sum(
+        out.astype(jnp.float32) * do.astype(jnp.float32), axis=-1,
+        keepdims=True,
+    )  # (BH, T, 1), same layout as lse
+    nb = t // block
 
+    # dq: grid (BH, q block, k block), k/v streamed, clamped at the diagonal
+    kv_stream = pl.BlockSpec(
+        (1, block, hd), lambda b, i, j: (b, jnp.minimum(j, i), 0))
+    q_fixed = pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0))
+    vec_fixed = pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, block=block, t=t),
-        grid=grid,
-        in_specs=[qspec_blk, qspec_full, qspec_full, qspec_blk, vec_blk, vec_blk],
-        out_specs=[qspec_blk],
+        functools.partial(_dq_kernel, scale=scale, block=block),
+        grid=(bh, nb, nb),
+        in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, vec_fixed,
+                  vec_fixed],
+        out_specs=[q_fixed],
         out_shape=[jax.ShapeDtypeStruct((bh, t, hd), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)[0]
 
+    # dk/dv: grid (BH, k block, q block), q/do/lse/delta streamed, clamped
+    q_stream = pl.BlockSpec(
+        (1, block, hd), lambda b, j, i: (b, jnp.maximum(i, j), 0))
+    vec_stream = pl.BlockSpec(
+        (1, block, 1), lambda b, j, i: (b, jnp.maximum(i, j), 0))
+    kv_fixed = pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, block=block, t=t),
-        grid=grid,
-        in_specs=[qspec_full, qspec_blk, qspec_blk, qspec_full, vec_full, vec_full],
-        out_specs=[qspec_blk, qspec_blk],
+        functools.partial(_dkv_kernel, scale=scale, block=block),
+        grid=(bh, nb, nb),
+        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, vec_stream,
+                  vec_stream],
+        out_specs=[kv_fixed, kv_fixed],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t, hd), k.dtype),
             jax.ShapeDtypeStruct((bh, t, hd), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block, hd), jnp.float32),
+            pltpu.VMEM((block, hd), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)

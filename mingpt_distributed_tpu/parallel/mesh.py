@@ -59,14 +59,29 @@ def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple[int, int, int, 
 def make_mesh(
     cfg: Optional[MeshConfig] = None, devices: Optional[Sequence[jax.Device]] = None
 ) -> Mesh:
-    """Build the named mesh. Device order: jax.devices() is already laid out
-    so that neighbouring ids are ICI neighbours on TPU; inner mesh axes (tp,
-    sp) get the fastest-varying dimension so tensor/sequence collectives ride
-    ICI while dp/fsdp cross slices (SURVEY §2.3's ICI/DCN mapping)."""
+    """Build the named mesh.
+
+    Without an explicit device list, device placement is delegated to
+    ``jax.experimental.mesh_utils.create_device_mesh``, which knows the
+    physical TPU topology (ICI torus links) and lays the mesh out so the
+    fastest-varying axes (tp, sp — tensor/sequence collectives) ride ICI
+    while dp/fsdp cross slices/DCN (SURVEY §2.3's ICI/DCN mapping). A naive
+    ``jax.devices()`` reshape instead assumes neighbouring ids are ICI
+    neighbours, which real multi-host slices violate.
+
+    Passing ``devices`` explicitly is the escape hatch for tests and for the
+    driver's virtual-CPU dry run: those devices are used in the given order.
+    """
     cfg = cfg or MeshConfig()
-    devs = list(devices) if devices is not None else jax.devices()
-    shape = resolve_mesh_shape(cfg, len(devs))
-    arr = np.array(devs).reshape(shape)
+    if devices is not None:
+        devs = list(devices)
+        shape = resolve_mesh_shape(cfg, len(devs))
+        arr = np.array(devs).reshape(shape)
+        return Mesh(arr, AXES)
+    shape = resolve_mesh_shape(cfg, len(jax.devices()))
+    from jax.experimental import mesh_utils
+
+    arr = mesh_utils.create_device_mesh(shape)
     return Mesh(arr, AXES)
 
 

@@ -47,10 +47,8 @@ def _ring_shard(q, k, v, *, axis_name: str, scale: float):
     q_pos = idx * c + jax.lax.broadcasted_iota(jnp.int32, (c, c), 0)
     k_local = jax.lax.broadcasted_iota(jnp.int32, (c, c), 1)
 
-    perm = None  # filled per-call below; scan body closes over axis size
-
-    def body(carry, i):
-        m, l, acc, kc, vc = carry
+    def fold(m, l, acc, kc, vc, i):
+        """Accumulate the currently-held K/V chunk into the online softmax."""
         src = (idx - i) % n  # origin device of the chunk we currently hold
         s = jnp.einsum(
             "bthd,bshd->bhts", qf, kc.astype(jnp.float32),
@@ -67,18 +65,27 @@ def _ring_shard(q, k, v, *, axis_name: str, scale: float):
             "bhts,bshd->bhtd", p, vc.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
+        return m_new, l, acc
+
+    def body(carry, i):
+        m, l, acc, kc, vc = carry
+        m, l, acc = fold(m, l, acc, kc, vc, i)
         # rotate K/V one hop around the ring (ICI neighbour exchange)
         shift = [(j, (j + 1) % n) for j in range(n)]
         kc = jax.lax.ppermute(kc, axis_name, shift)
         vc = jax.lax.ppermute(vc, axis_name, shift)
-        return (m_new, l, acc, kc, vc), None
+        return (m, l, acc, kc, vc), None
 
     m0 = jnp.full((b, h, c, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((b, h, c, 1), jnp.float32)
     acc0 = jnp.zeros((b, h, c, hd), jnp.float32)
-    (m, l, acc, _, _), _ = jax.lax.scan(
-        body, (m0, l0, acc0, k, v), jnp.arange(n)
+    # scan the first n-1 hops; the last chunk is folded outside the scan so
+    # its rotation (whose result nobody reads) never happens — one saved
+    # K/V hop per layer per step
+    (m, l, acc, kc, vc), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(n - 1)
     )
+    m, l, acc = fold(m, l, acc, kc, vc, n - 1)
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.einsum("bhtd->bthd", out).astype(q.dtype)
 

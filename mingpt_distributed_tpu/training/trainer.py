@@ -337,6 +337,12 @@ class GPTTrainer:
                     stop = True
                     break
             if stop:
+                # stop the producer thread BEFORE touching iterator state:
+                # it mutates train_iter.state ahead of consumption, and a
+                # write landing after the re-sync below would persist a data
+                # position beyond what was trained (resume would skip batches)
+                if cfg.prefetch > 0:
+                    source.close()
                 # re-sync iterator state to the batches actually trained on
                 # (prefetch ran ahead); resume continues at exactly here
                 self.train_iter.state = IteratorState(

@@ -37,13 +37,15 @@ def main(argv=None) -> int:
     import jax
 
     from mingpt_distributed_tpu.config import load_config
-    from mingpt_distributed_tpu.data.char_dataset import CharDataset
+    from mingpt_distributed_tpu.data.token_dataset import make_dataset
     from mingpt_distributed_tpu.models import generate as gen
     from mingpt_distributed_tpu.models import gpt
     from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
 
     cfg = load_config(args.config, args.overrides)
-    dataset = CharDataset(cfg.data_config)
+    # same tokenizer dispatch as train.py: the snapshot being sampled was
+    # trained on this config's vocabulary
+    dataset = make_dataset(cfg.data_config)
     gpt_cfg = dataclasses.replace(
         cfg.gpt_config,
         vocab_size=dataset.vocab_size,
@@ -56,7 +58,14 @@ def main(argv=None) -> int:
     params_shape = jax.eval_shape(
         lambda k: gpt.init(k, gpt_cfg), jax.random.key(0)
     )
-    snap = ckpt_lib.load_snapshot(path, params_shape, {})
+    # same backend dispatch as the trainer: .msgpack = single blob, anything
+    # else = Orbax directory (a sharded checkpoint is not an openable file)
+    if path.endswith(".msgpack"):
+        snap = ckpt_lib.load_snapshot(path, params_shape)
+    else:
+        from mingpt_distributed_tpu.training import checkpoint_orbax
+
+        snap = checkpoint_orbax.load_snapshot(path, params_shape)
     if snap is None:
         print(f"no snapshot at {path}; train first (python train.py)",
               file=sys.stderr)

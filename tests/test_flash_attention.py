@@ -81,6 +81,30 @@ def test_gradient_parity_gqa_multiblock():
         )
 
 
+def test_gradient_parity_long_sequence():
+    """T=1024 -> 512-blocks streamed via the grid (the FA2 re-tiling): the
+    per-cell VMEM footprint must not depend on T, and the scratch-carried
+    online softmax must stay exact across many k blocks."""
+    q, k, v = qkv(b=1, t=1024, h=2, seed=11)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v)))
+
+    want = attn_ops.causal_attention(q, k, v)
+    got = flash.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    for want, got, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-4,
+            err_msg=f"d{name} mismatch",
+        )
+
+
 def test_fallback_paths_route_to_oracle():
     # dropout active -> einsum fallback (still correct, just not flash)
     q, k, v = qkv(t=64)

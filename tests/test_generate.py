@@ -77,15 +77,49 @@ def test_top_k_restricts_support():
                  rng=jax.random.key(0))
 
 
-def test_prompt_cropped_to_fit_cache():
+def test_generation_crosses_context_window():
+    """Unbounded generation (reference model.py:336-337): max_new_tokens may
+    exceed the room left in — or the entirety of — the context window; every
+    token past the boundary must match the crop-and-append dense oracle."""
+    cfg, params = cfg_and_params(block_size=16)
+    prompt = jax.random.randint(jax.random.key(1), (2, 10), 0, 50)
+    n = 20  # 10 + 20 > 16: crosses the boundary mid-generation
+    want = dense_greedy(params, cfg, prompt, n)
+    got = gen.generate(params, cfg, prompt, n)
+    assert got.shape == (2, 30)  # full prompt stays in the output
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_generation_exceeds_block_size_entirely():
+    """max_new_tokens > block_size: the window slides the whole way."""
+    cfg, params = cfg_and_params(block_size=16)
+    prompt = jax.random.randint(jax.random.key(2), (1, 3), 0, 50)
+    n = 24  # > block_size
+    want = dense_greedy(params, cfg, prompt, n)
+    got = gen.generate(params, cfg, prompt, n)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_long_prompt_cropped_but_preserved_in_output():
     cfg, params = cfg_and_params(block_size=16)
     long_prompt = jax.random.randint(jax.random.key(1), (1, 40), 0, 50)
+    want = dense_greedy(params, cfg, long_prompt, 4)
     out = gen.generate(params, cfg, long_prompt, 4)
-    # kept = block_size - max_new = 12 prompt tokens + 4 generated
-    assert out.shape == (1, 16)
-    np.testing.assert_array_equal(
-        np.asarray(out[:, :12]), np.asarray(long_prompt[:, -12:])
-    )
+    assert out.shape == (1, 44)  # reference returns prompt + new tokens
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(out))
+
+
+def test_sliding_window_sampling_in_bounds():
+    """Sampled decode across the boundary stays in-vocab and deterministic
+    under a fixed key (the sliding path threads the same PRNG contract)."""
+    cfg, params = cfg_and_params(block_size=16)
+    prompt = jnp.zeros((1, 3), dtype=jnp.int32)
+    a = gen.generate(params, cfg, prompt, 20, do_sample=True, temperature=0.9,
+                     top_k=5, rng=jax.random.key(7))
+    b = gen.generate(params, cfg, prompt, 20, do_sample=True, temperature=0.9,
+                     top_k=5, rng=jax.random.key(7))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(np.asarray(a).max()) < 50 and int(np.asarray(a).min()) >= 0
 
 
 def test_1d_prompt_and_single_token():

@@ -129,10 +129,12 @@ def test_gpt_class_facade(hf_small, capsys):
 @pytest.fixture(scope="module")
 def hf_llama():
     """Small random LlamaForCausalLM built locally (no download)."""
+    # rope_theta 500000 (the real Llama-3 base, config.py llama-3-8b preset):
+    # parity here proves theta flows through rope_tables, not just the default
     cfg = transformers.LlamaConfig(
         vocab_size=97, hidden_size=48, intermediate_size=128,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-        max_position_embeddings=64, rope_theta=10000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=64, rope_theta=500000.0, rms_norm_eps=1e-5,
         attention_dropout=0.0, tie_word_embeddings=False,
     )
     torch.manual_seed(0)
@@ -147,7 +149,13 @@ def llama_cfg():
         embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
         rope=True, swiglu=True, rmsnorm=True, n_kv_head=2,
         ffn_mult=128 / 48, tie_weights=False, norm_eps=1e-5,
+        rope_theta=500000.0,
     )
+
+
+def test_llama3_preset_rope_theta():
+    cfg = GPTConfig.make(model_type="llama-3-8b")
+    assert cfg.rope_theta == 500000.0
 
 
 def test_llama_logit_parity_with_torch(hf_llama):

@@ -44,14 +44,16 @@ def main(argv=None) -> int:
     distributed.initialize()  # init_process_group analogue (no-op single host)
 
     from mingpt_distributed_tpu.config import load_config
-    from mingpt_distributed_tpu.data.char_dataset import CharDataset
+    from mingpt_distributed_tpu.data.token_dataset import make_dataset
     from mingpt_distributed_tpu.training.trainer import GPTTrainer
 
     cfg = load_config(args.config, args.overrides)
 
     # get_resources (reference train.py:11-27): dataset -> split -> override
     # model vocab/block from the data -> trainer owns model+optimizer configs.
-    dataset = CharDataset(cfg.data_config)
+    # make_dataset dispatches on data_config.tokenizer: char (reference
+    # semantics) or bpe (the upstream bpe.py capability, README.md:10-15).
+    dataset = make_dataset(cfg.data_config)
     train_view, test_view = dataset.split()
     gpt_cfg = dataclasses.replace(
         cfg.gpt_config,
@@ -59,8 +61,9 @@ def main(argv=None) -> int:
         block_size=dataset.block_size,
     )
     if jax.process_index() == 0:
+        unit = "tokens" if cfg.data_config.tokenizer == "bpe" else "chars"
         print(
-            f"data: {len(dataset.data)} chars, vocab {dataset.vocab_size}, "
+            f"data: {len(dataset.data)} {unit}, vocab {dataset.vocab_size}, "
             f"{len(train_view)} train / {len(test_view)} test windows"
         )
 
