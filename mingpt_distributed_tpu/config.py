@@ -104,6 +104,10 @@ class GPTConfig:
     # Rematerialise each block in backward (jax.checkpoint) to trade FLOPs
     # for HBM.
     remat: bool = False
+    # GPipe microbatch count when the mesh has pp > 1 stages; 0 = one
+    # microbatch per stage. Bubble fraction is (pp-1)/(M+pp-1), so raise M
+    # for efficiency, bounded by batch divisibility and activation memory.
+    pp_microbatches: int = 0
     # Tie the LM head to the token embedding (GPT-2 ties; the reference's
     # head is an independent bias-free Linear, model.py:249 — keep that as
     # the default for parity).
@@ -116,6 +120,13 @@ class GPTConfig:
     n_kv_head: Optional[int] = None  # grouped-query attention; None = n_head
     ffn_mult: float = 4.0  # MLP expansion factor (reference hardcodes 4x)
     norm_eps: float = 1e-5  # LayerNorm/RMSNorm epsilon
+    # Mixture-of-experts (ops/moe.py): 0 = dense MLP (reference semantics);
+    # E > 0 replaces every block's MLP with E GELU experts, top-k routed,
+    # expert axis sharded over the mesh's `ep` axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01  # load-balancing loss weight
 
     @classmethod
     def make(cls, **kwargs: Any) -> "GPTConfig":
@@ -176,6 +187,15 @@ class GPTConfig:
             )
         if self.block_size <= 0 or self.vocab_size <= 0:
             raise ConfigError("block_size and vocab_size must be positive")
+        if self.n_experts:
+            if self.swiglu:
+                raise ConfigError(
+                    "n_experts currently requires the GELU MLP (swiglu=False)"
+                )
+            if self.moe_top_k < 1 or self.moe_top_k > self.n_experts:
+                raise ConfigError(
+                    f"moe_top_k={self.moe_top_k} outside [1, {self.n_experts}]"
+                )
 
     @property
     def head_dim(self) -> int:
@@ -244,12 +264,15 @@ class MeshConfig:
 
     Replaces the reference's implicit "one process per GPU, DDP over all"
     topology (trainer.py:71, slurm_run.sh:17-23) with an explicit named mesh:
-    ``dp`` (data), ``fsdp`` (param shards), ``tp`` (tensor), ``sp`` (sequence,
-    for ring attention). -1 means "absorb all remaining devices".
+    ``pp`` (pipeline stages), ``dp`` (data), ``fsdp`` (param shards), ``ep``
+    (experts — also shards the batch, GShard-style), ``tp`` (tensor), ``sp``
+    (sequence, for ring attention). -1 means "absorb all remaining devices".
     """
 
+    pp: int = 1
     dp: int = -1
     fsdp: int = 1
+    ep: int = 1
     tp: int = 1
     sp: int = 1
 

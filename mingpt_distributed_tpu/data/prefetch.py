@@ -70,6 +70,14 @@ class PrefetchIterator:
         except queue.Empty:
             pass
         self._thread.join(timeout=10.0)
+        if self._thread.is_alive():
+            # the caller is about to mutate state the producer still touches
+            # — continuing silently would reintroduce the race close() exists
+            # to prevent
+            raise RuntimeError(
+                "prefetch producer thread failed to stop within 10s "
+                "(source iterator blocked?)"
+            )
 
     def __iter__(self) -> "PrefetchIterator":
         return self

@@ -84,7 +84,14 @@ def _cached_block(
     x = x + att
 
     h2 = gpt._norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg)
-    if cfg.swiglu:
+    if cfg.n_experts:
+        from mingpt_distributed_tpu.ops import moe
+
+        m, _ = moe.moe_mlp(
+            h2, blk["w_router"], blk["w_e1"], blk["w_e2"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+        )
+    elif cfg.swiglu:
         m = L.mlp_swiglu(h2, blk["w_gate"], blk["w_up"], blk["w_down"])
     else:
         m = L.mlp_gelu(h2, blk["w_fc"], blk.get("b_fc"), blk["w_proj"],

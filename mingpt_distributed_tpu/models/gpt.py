@@ -83,7 +83,14 @@ def init(key: jax.Array, cfg: GPTConfig) -> Params:
             bv=jnp.zeros((nl, kv * hd)),
             bo=jnp.zeros((nl, d)),
         )
-    if cfg.swiglu:
+    if cfg.n_experts:
+        e = cfg.n_experts
+        blocks.update(
+            w_router=normal(next(keys), (nl, d, e)),
+            w_e1=normal(next(keys), (nl, e, d, ffn)),
+            w_e2=normal(next(keys), (nl, e, ffn, d), resid_std),
+        )
+    elif cfg.swiglu:
         blocks.update(
             w_gate=normal(next(keys), (nl, d, ffn)),
             w_up=normal(next(keys), (nl, d, ffn)),
@@ -160,8 +167,11 @@ def _block(
     drop_key: Optional[jax.Array],
     deterministic: bool,
     mesh=None,
-) -> jax.Array:
-    """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x))."""
+) -> Tuple[jax.Array, jax.Array]:
+    """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x)).
+
+    Returns (x, aux): aux is the MoE load-balancing loss for this layer
+    (zero for dense MLPs) — accumulated across layers by the caller."""
     b, t, d = x.shape
     nh, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
     if drop_key is not None:
@@ -188,12 +198,20 @@ def _block(
     x = x + att
 
     h2 = _norm(x, blk["ln2_scale"], blk.get("ln2_bias"), cfg)
-    if cfg.swiglu:
+    aux = jnp.zeros((), jnp.float32)
+    if cfg.n_experts:
+        from mingpt_distributed_tpu.ops import moe
+
+        m, aux = moe.moe_mlp(
+            h2, blk["w_router"], blk["w_e1"], blk["w_e2"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+        )
+    elif cfg.swiglu:
         m = L.mlp_swiglu(h2, blk["w_gate"], blk["w_up"], blk["w_down"])
     else:
         m = L.mlp_gelu(h2, blk["w_fc"], blk.get("b_fc"), blk["w_proj"], blk.get("b_proj"))
     m = L.dropout(m, cfg.resid_pdrop, k_resid2, deterministic)
-    return x + m
+    return x + m, aux
 
 
 def forward(
@@ -237,19 +255,65 @@ def forward(
 
     nl = cfg.n_layer
     if deterministic:
-        layer_keys = None
         def body(carry, blk):
-            return _block(carry, blk, cfg, rope, None, True, mesh), None
+            xc, aux = carry
+            y, a = _block(xc, blk, cfg, rope, None, True, mesh)
+            return (y, aux + a), None
         xs = params["blocks"]
     else:
         layer_keys = jax.random.split(rng, nl)
         def body(carry, scanned):
             blk, key = scanned
-            return _block(carry, blk, cfg, rope, key, False, mesh), None
+            xc, aux = carry
+            y, a = _block(xc, blk, cfg, rope, key, False, mesh)
+            return (y, aux + a), None
         xs = (params["blocks"], layer_keys)
 
     step = jax.checkpoint(body) if cfg.remat else body
-    x, _ = jax.lax.scan(step, x, xs)
+
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        # pipeline stages over the pp axis (parallel/pipeline.py): the same
+        # scanned block, applied to each stage's layer shard per microbatch.
+        # rope tables travel as explicit replicated consts — shard_map must
+        # see every traced value it uses.
+        from mingpt_distributed_tpu.parallel import pipeline
+
+        if cfg.attention in ("ring", "ulysses"):
+            raise NotImplementedError(
+                "sequence-parallel attention inside pipeline stages is not "
+                "supported; use attention='einsum'/'flash' with pp > 1"
+            )
+        if cfg.n_experts:
+            raise NotImplementedError(
+                "MoE inside pipeline stages is not supported yet; use "
+                "pp=1 with n_experts > 0 (ep shards the experts instead)"
+            )
+
+        def apply_stack(x_mb, xs_local, consts, mb_idx):
+            rope_c = consts if cfg.rope else None
+            if deterministic:
+                def body_pp(carry, blk):
+                    return _block(carry, blk, cfg, rope_c, None, True)[0], None
+            else:
+                def body_pp(carry, scanned):
+                    blk, key = scanned
+                    # decorrelate dropout across microbatches: the same
+                    # layer key is applied to every microbatch otherwise
+                    key = jax.random.fold_in(key, mb_idx)
+                    return _block(carry, blk, cfg, rope_c, key, False)[0], None
+            step_pp = jax.checkpoint(body_pp) if cfg.remat else body_pp
+            y, _ = jax.lax.scan(step_pp, x_mb, xs_local)
+            return y
+
+        x = pipeline.pipeline_blocks(
+            x, xs, rope if cfg.rope else (), apply_stack, mesh,
+            n_microbatches=cfg.pp_microbatches,
+        )
+        moe_aux = jnp.zeros((), jnp.float32)
+    else:
+        (x, moe_aux), _ = jax.lax.scan(
+            step, (x, jnp.zeros((), jnp.float32)), xs
+        )
 
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)
     w_head = params["wte"].T if cfg.tie_weights else params["head"]
@@ -261,6 +325,9 @@ def forward(
     loss = None
     if targets is not None:
         loss = cross_entropy(logits, targets)
+        if cfg.n_experts:
+            # per-layer-mean load-balancing loss (Switch Transformer)
+            loss = loss + cfg.moe_aux_weight * moe_aux / nl
     return logits, loss
 
 

@@ -1,0 +1,99 @@
+"""Mixture-of-experts MLP with capacity-based dispatch (GShard/Switch style).
+
+Beyond-parity capability (SURVEY §2.2: the reference has a dense MLP only,
+model.py:179-184; EP/MoE marked absent). TPU-native design: dispatch and
+combine are dense einsums against a static-shape (tokens, experts, capacity)
+one-hot tensor — no dynamic shapes, no host control flow — so the whole layer
+jits into one XLA program. Expert weights carry a leading expert axis that
+shards over the mesh's ``ep`` axis (parallel/mesh.py PARAM_RULES); since the
+token axis is batch-sharded over dp/fsdp/ep, the dispatch einsum contracts a
+token-sharded tensor against expert-sharded weights and **GSPMD inserts the
+all-to-alls** — the hand-written NCCL alltoall of GPU MoE stacks becomes a
+compiler decision (the framework's ICI/DCN story, SURVEY §2.3).
+
+Routing: softmax router, top-k (k=1 Switch, k=2 GShard default), gates
+renormalised over the chosen k. Capacity C = ceil(k·S/E · capacity_factor);
+tokens overflowing an expert's capacity are dropped for that slot (their
+residual path still carries them — standard behaviour). Load-balancing aux
+loss is the Switch-Transformer one: E · Σ_e f_e · P_e, where f_e is the
+fraction of tokens whose top-1 choice is e and P_e the mean router prob.
+
+Caveat: when capacity binds, which tokens drop depends on the *set* of
+tokens evaluated together — so KV-cached decode (one token at a time) only
+reproduces a full re-forward when capacity_factor is high enough that
+nothing drops (factor >= E/k guarantees it). Training is unaffected.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def moe_mlp(
+    x: jax.Array,        # (B, T, D) — post-norm activations
+    w_router: jax.Array,  # (D, E)
+    w_e1: jax.Array,      # (E, D, F)
+    w_e2: jax.Array,      # (E, F, D)
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+) -> Tuple[jax.Array, jax.Array]:
+    """Expert-routed GELU MLP. Returns (out (B, T, D), aux_loss scalar)."""
+    b, t, d = x.shape
+    e = w_e1.shape[0]
+    s = b * t
+    xs = x.reshape(s, d)
+
+    logits = jnp.einsum(
+        "sd,de->se", xs.astype(jnp.float32), w_router.astype(jnp.float32)
+    )
+    probs = jax.nn.softmax(logits, axis=-1)  # (S, E) fp32
+
+    cap = max(1, math.ceil(top_k * s / e * capacity_factor))
+
+    # top-k routing with running per-expert position counters
+    remaining = probs
+    counts = jnp.zeros((e,), jnp.float32)
+    dispatch = jnp.zeros((s, e, cap), jnp.float32)
+    combine = jnp.zeros((s, e, cap), jnp.float32)
+    gates, onehots = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)            # (S,)
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (S, E)
+        gates.append(jnp.sum(probs * oh, axis=-1))      # true prob, not masked
+        onehots.append(oh)
+        remaining = remaining * (1.0 - oh)
+    denom = sum(gates)
+    for g, oh in zip(gates, onehots):
+        # position of each token within its expert's buffer, honouring
+        # tokens already placed by earlier slots
+        pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh   # (S, E)
+        keep = oh * (pos < cap)
+        counts = counts + jnp.sum(keep, axis=0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        sel = keep[..., None] * slot                     # (S, E, C)
+        dispatch = dispatch + sel
+        combine = combine + sel * (g / jnp.maximum(denom, 1e-9))[:, None, None]
+
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), xs)
+    h = jax.nn.gelu(jnp.einsum(
+        "ecd,edf->ecf", expert_in, w_e1.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )).astype(x.dtype)
+    expert_out = jnp.einsum(
+        "ecf,efd->ecd", h, w_e2.astype(x.dtype),
+        preferred_element_type=jnp.float32,
+    )
+    out = jnp.einsum(
+        "sec,ecd->sd", combine.astype(jnp.float32), expert_out
+    ).astype(x.dtype)
+
+    # Switch load-balancing loss on top-1 assignment
+    f = jnp.mean(onehots[0], axis=0)      # fraction routed to each expert
+    p = jnp.mean(probs, axis=0)           # mean router prob per expert
+    aux = e * jnp.sum(f * p)
+    return out.reshape(b, t, d), aux

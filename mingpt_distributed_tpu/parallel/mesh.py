@@ -30,15 +30,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from mingpt_distributed_tpu.config import MeshConfig
 from mingpt_distributed_tpu.utils.pytree import leaf_name
 
-AXES = ("dp", "fsdp", "tp", "sp")
-# Batch is split over every data-ish axis; dp and fsdp both shard the batch,
+# pp outermost: pipeline stages exchange activations point-to-point once per
+# microbatch tick — the least bandwidth-hungry axis, so it can cross DCN;
+# tp/sp innermost ride ICI.
+AXES = ("pp", "dp", "fsdp", "ep", "tp", "sp")
+# Batch is split over every data-ish axis; dp, fsdp and ep all shard the
+# batch (ep doubles as a data axis outside expert layers, GShard-style),
 # sp shards the sequence (ring attention), tp replicates the batch.
-BATCH_AXES = ("dp", "fsdp")
+BATCH_AXES = ("dp", "fsdp", "ep")
 
 
-def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple[int, int, int, int]:
+def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple[int, ...]:
     """Resolve -1 entries ("absorb remaining devices") and validate."""
-    dims = [cfg.dp, cfg.fsdp, cfg.tp, cfg.sp]
+    dims = [getattr(cfg, "pp", 1), cfg.dp, cfg.fsdp, getattr(cfg, "ep", 1),
+            cfg.tp, cfg.sp]
     if dims.count(-1) > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {dims}")
     known = math.prod(d for d in dims if d != -1)
@@ -115,26 +120,31 @@ PARAM_RULES: dict[str, P] = {
     "head": P("tp", "fsdp"),
     "lnf_scale": P(None),
     "lnf_bias": P(None),
-    # blocks (leading layer axis)
-    "wq": P(None, "fsdp", "tp"),
-    "wk": P(None, "fsdp", "tp"),
-    "wv": P(None, "fsdp", "tp"),
-    "wo": P(None, "tp", "fsdp"),
-    "w_fc": P(None, "fsdp", "tp"),
-    "w_gate": P(None, "fsdp", "tp"),
-    "w_up": P(None, "fsdp", "tp"),
-    "w_proj": P(None, "tp", "fsdp"),
-    "w_down": P(None, "tp", "fsdp"),
-    "bq": P(None, "tp"),
-    "bk": P(None, "tp"),
-    "bv": P(None, "tp"),
-    "bo": P(None, None),
-    "b_fc": P(None, "tp"),
-    "b_proj": P(None, None),
-    "ln1_scale": P(None, None),
-    "ln1_bias": P(None, None),
-    "ln2_scale": P(None, None),
-    "ln2_bias": P(None, None),
+    # blocks (leading layer axis, sharded over pipeline stages; pp=1 = no-op)
+    "wq": P("pp", "fsdp", "tp"),
+    "wk": P("pp", "fsdp", "tp"),
+    "wv": P("pp", "fsdp", "tp"),
+    "wo": P("pp", "tp", "fsdp"),
+    "w_fc": P("pp", "fsdp", "tp"),
+    "w_gate": P("pp", "fsdp", "tp"),
+    "w_up": P("pp", "fsdp", "tp"),
+    "w_proj": P("pp", "tp", "fsdp"),
+    "w_down": P("pp", "tp", "fsdp"),
+    "bq": P("pp", "tp"),
+    "bk": P("pp", "tp"),
+    "bv": P("pp", "tp"),
+    "bo": P("pp", None),
+    "b_fc": P("pp", "tp"),
+    "b_proj": P("pp", None),
+    "ln1_scale": P("pp", None),
+    "ln1_bias": P("pp", None),
+    "ln2_scale": P("pp", None),
+    "ln2_bias": P("pp", None),
+    # MoE (ops/moe.py): expert axis over ep; expert matrices additionally
+    # fsdp/tp-sharded like their dense counterparts
+    "w_router": P("pp", None, None),
+    "w_e1": P("pp", "ep", "fsdp", "tp"),
+    "w_e2": P("pp", "ep", "tp", "fsdp"),
 }
 
 

@@ -136,9 +136,10 @@ class GPTTrainer:
             np.prod([self.mesh.shape[a] for a in mesh_lib.BATCH_AXES])
         )
         if config.batch_size % batch_ways != 0:
+            axes = "*".join(mesh_lib.BATCH_AXES)
             raise ValueError(
                 f"trainer_config.batch_size={config.batch_size} must be "
-                f"divisible by dp*fsdp={batch_ways} (mesh "
+                f"divisible by {axes}={batch_ways} (mesh "
                 f"{dict(self.mesh.shape)})"
             )
 

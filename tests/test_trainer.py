@@ -47,7 +47,8 @@ def make_trainer(tmp_path, mesh_cfg=None, snapshot=None, **trainer_kw):
     tkw.update(trainer_kw)
     tcfg = TrainerConfig.make(**tkw)
     mesh_cfg = mesh_cfg or MeshConfig(dp=-1)
-    dims = [mesh_cfg.dp, mesh_cfg.fsdp, mesh_cfg.tp, mesh_cfg.sp]
+    dims = [mesh_cfg.pp, mesh_cfg.dp, mesh_cfg.fsdp, mesh_cfg.ep,
+            mesh_cfg.tp, mesh_cfg.sp]
     devs = None if -1 in dims else jax.devices()[: int(np.prod(dims))]
     mesh = mesh_lib.make_mesh(mesh_cfg, devices=devs)
     return GPTTrainer(
