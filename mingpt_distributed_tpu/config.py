@@ -188,10 +188,6 @@ class GPTConfig:
         if self.block_size <= 0 or self.vocab_size <= 0:
             raise ConfigError("block_size and vocab_size must be positive")
         if self.n_experts:
-            if self.swiglu:
-                raise ConfigError(
-                    "n_experts currently requires the GELU MLP (swiglu=False)"
-                )
             if self.moe_top_k < 1 or self.moe_top_k > self.n_experts:
                 raise ConfigError(
                     f"moe_top_k={self.moe_top_k} outside [1, {self.n_experts}]"

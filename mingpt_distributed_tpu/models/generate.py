@@ -90,6 +90,7 @@ def _cached_block(
         m, _ = moe.moe_mlp(
             h2, blk["w_router"], blk["w_e1"], blk["w_e2"],
             top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            w_gate=blk.get("w_eg"),
         )
     elif cfg.swiglu:
         m = L.mlp_swiglu(h2, blk["w_gate"], blk["w_up"], blk["w_down"])

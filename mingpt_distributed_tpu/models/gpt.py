@@ -90,6 +90,8 @@ def init(key: jax.Array, cfg: GPTConfig) -> Params:
             w_e1=normal(next(keys), (nl, e, d, ffn)),
             w_e2=normal(next(keys), (nl, e, ffn, d), resid_std),
         )
+        if cfg.swiglu:  # Mixtral-style SwiGLU experts
+            blocks["w_eg"] = normal(next(keys), (nl, e, d, ffn))
     elif cfg.swiglu:
         blocks.update(
             w_gate=normal(next(keys), (nl, d, ffn)),
@@ -205,6 +207,7 @@ def _block(
         m, aux = moe.moe_mlp(
             h2, blk["w_router"], blk["w_e1"], blk["w_e2"],
             top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            w_gate=blk.get("w_eg"),
         )
     elif cfg.swiglu:
         m = L.mlp_swiglu(h2, blk["w_gate"], blk["w_up"], blk["w_down"])

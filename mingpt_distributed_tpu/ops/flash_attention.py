@@ -148,6 +148,11 @@ def _flash_fwd(q, k, v, scale, block):
             pltpu.VMEM((block, 1), jnp.float32),
             pltpu.VMEM((block, hd), jnp.float32),
         ],
+        # bh and q-block cells are independent; only the k dimension carries
+        # the online-softmax state sequentially
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
@@ -272,6 +277,9 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block):
         out_specs=[q_fixed],
         out_shape=[jax.ShapeDtypeStruct((bh, t, hd), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)[0]
 
@@ -295,6 +303,9 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block):
             pltpu.VMEM((block, hd), jnp.float32),
             pltpu.VMEM((block, hd), jnp.float32),
         ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv

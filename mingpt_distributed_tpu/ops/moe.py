@@ -2,21 +2,29 @@
 
 Beyond-parity capability (SURVEY §2.2: the reference has a dense MLP only,
 model.py:179-184; EP/MoE marked absent). TPU-native design: dispatch and
-combine are dense einsums against a static-shape (tokens, experts, capacity)
-one-hot tensor — no dynamic shapes, no host control flow — so the whole layer
-jits into one XLA program. Expert weights carry a leading expert axis that
-shards over the mesh's ``ep`` axis (parallel/mesh.py PARAM_RULES); since the
-token axis is batch-sharded over dp/fsdp/ep, the dispatch einsum contracts a
-token-sharded tensor against expert-sharded weights and **GSPMD inserts the
-all-to-alls** — the hand-written NCCL alltoall of GPU MoE stacks becomes a
-compiler decision (the framework's ICI/DCN story, SURVEY §2.3).
+combine are dense einsums against a static-shape one-hot tensor — no dynamic
+shapes, no host control flow — so the whole layer jits into one XLA program.
+Expert weights carry a leading expert axis that shards over the mesh's ``ep``
+axis (parallel/mesh.py PARAM_RULES); since the token axis is batch-sharded
+over dp/fsdp/ep, the dispatch einsum contracts a token-sharded tensor against
+expert-sharded weights and **GSPMD inserts the all-to-alls** — the
+hand-written NCCL alltoall of GPU MoE stacks becomes a compiler decision
+(the framework's ICI/DCN story, SURVEY §2.3).
 
-Routing: softmax router, top-k (k=1 Switch, k=2 GShard default), gates
-renormalised over the chosen k. Capacity C = ceil(k·S/E · capacity_factor);
-tokens overflowing an expert's capacity are dropped for that slot (their
-residual path still carries them — standard behaviour). Load-balancing aux
-loss is the Switch-Transformer one: E · Σ_e f_e · P_e, where f_e is the
-fraction of tokens whose top-1 choice is e and P_e the mean router prob.
+Tokens are routed in fixed-size **groups** (GShard's trick): the one-hot
+dispatch tensor is (G, group, E, cap_per_group), so its memory is
+k·factor·group·S — *linear* in sequence length — instead of the k·factor·S²
+a single global group would cost (which at block_size 8192 would be GBs per
+layer). Capacity is per group; cross-group imbalance can drop slightly more
+tokens than global routing, the standard trade-off.
+
+Routing: softmax router, top-k. k=1 (Switch) scales expert output by the
+raw router probability — required so the router receives task-loss gradient
+(with renormalised gates the k=1 weight is identically 1 and d loss/d router
+== 0). k>=2 (GShard) renormalises the chosen gates to sum to 1. Tokens
+overflowing an expert's per-group capacity are dropped for that slot (their
+residual path still carries them). Load-balancing aux loss is the
+Switch-Transformer one: E · Σ_e f_e · P_e over all tokens.
 
 Caveat: when capacity binds, which tokens drop depends on the *set* of
 tokens evaluated together — so KV-cached decode (one token at a time) only
@@ -32,6 +40,51 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
+# Max tokens routed as one group; actual group size is the largest divisor
+# of S at most this (S itself for small inputs).
+MAX_GROUP = 1024
+
+
+def _group_size(s: int) -> int:
+    if s <= MAX_GROUP:
+        return s
+    for g in range(MAX_GROUP, 0, -1):
+        if s % g == 0:
+            return g
+    return s
+
+
+def _route_group(probs, *, top_k: int, cap: int):
+    """One group's dispatch/combine from (gs, E) router probs.
+
+    Returns (dispatch (gs, E, cap), combine (gs, E, cap), top1 (gs, E))."""
+    gs, e = probs.shape
+    remaining = probs
+    counts = jnp.zeros((e,), jnp.float32)
+    dispatch = jnp.zeros((gs, e, cap), jnp.float32)
+    combine = jnp.zeros((gs, e, cap), jnp.float32)
+    gates, onehots = [], []
+    for _ in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)            # (gs,)
+        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (gs, E)
+        gates.append(jnp.sum(probs * oh, axis=-1))      # true prob, not masked
+        onehots.append(oh)
+        remaining = remaining * (1.0 - oh)
+    # k=1: scale by the raw prob (Switch) so the router gets task gradient;
+    # k>1: renormalise over the chosen k (GShard)
+    denom = sum(gates) if top_k > 1 else jnp.ones_like(gates[0])
+    for g, oh in zip(gates, onehots):
+        # position of each token within its expert's buffer, honouring
+        # tokens already placed by earlier slots
+        pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh   # (gs, E)
+        keep = oh * (pos < cap)
+        counts = counts + jnp.sum(keep, axis=0)
+        slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
+        sel = keep[..., None] * slot                     # (gs, E, cap)
+        dispatch = dispatch + sel
+        combine = combine + sel * (g / jnp.maximum(denom, 1e-9))[:, None, None]
+    return dispatch, combine, onehots[0]
+
 
 def moe_mlp(
     x: jax.Array,        # (B, T, D) — post-norm activations
@@ -41,59 +94,54 @@ def moe_mlp(
     *,
     top_k: int = 2,
     capacity_factor: float = 1.25,
+    w_gate: jax.Array = None,  # (E, D, F): SwiGLU experts (Mixtral-style)
 ) -> Tuple[jax.Array, jax.Array]:
-    """Expert-routed GELU MLP. Returns (out (B, T, D), aux_loss scalar)."""
+    """Expert-routed MLP: GELU experts, or SwiGLU when ``w_gate`` is given
+    (h = silu(x·w_gate) * (x·w_e1), Mixtral-style). Returns
+    (out (B, T, D), aux_loss scalar)."""
     b, t, d = x.shape
     e = w_e1.shape[0]
     s = b * t
-    xs = x.reshape(s, d)
+    gs = _group_size(s)
+    ng = s // gs
+    xs = x.reshape(ng, gs, d)
 
     logits = jnp.einsum(
-        "sd,de->se", xs.astype(jnp.float32), w_router.astype(jnp.float32)
+        "gsd,de->gse", xs.astype(jnp.float32), w_router.astype(jnp.float32)
     )
-    probs = jax.nn.softmax(logits, axis=-1)  # (S, E) fp32
+    probs = jax.nn.softmax(logits, axis=-1)  # (G, gs, E) fp32
 
-    cap = max(1, math.ceil(top_k * s / e * capacity_factor))
+    cap = max(1, math.ceil(top_k * gs / e * capacity_factor))
+    dispatch, combine, top1 = jax.vmap(
+        lambda p: _route_group(p, top_k=top_k, cap=cap)
+    )(probs)  # (G, gs, E, cap) x2, (G, gs, E)
 
-    # top-k routing with running per-expert position counters
-    remaining = probs
-    counts = jnp.zeros((e,), jnp.float32)
-    dispatch = jnp.zeros((s, e, cap), jnp.float32)
-    combine = jnp.zeros((s, e, cap), jnp.float32)
-    gates, onehots = [], []
-    for _ in range(top_k):
-        idx = jnp.argmax(remaining, axis=-1)            # (S,)
-        oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (S, E)
-        gates.append(jnp.sum(probs * oh, axis=-1))      # true prob, not masked
-        onehots.append(oh)
-        remaining = remaining * (1.0 - oh)
-    denom = sum(gates)
-    for g, oh in zip(gates, onehots):
-        # position of each token within its expert's buffer, honouring
-        # tokens already placed by earlier slots
-        pos = counts[None, :] + jnp.cumsum(oh, axis=0) - oh   # (S, E)
-        keep = oh * (pos < cap)
-        counts = counts + jnp.sum(keep, axis=0)
-        slot = jax.nn.one_hot(pos.astype(jnp.int32), cap, dtype=jnp.float32)
-        sel = keep[..., None] * slot                     # (S, E, C)
-        dispatch = dispatch + sel
-        combine = combine + sel * (g / jnp.maximum(denom, 1e-9))[:, None, None]
-
-    expert_in = jnp.einsum("sec,sd->ecd", dispatch.astype(x.dtype), xs)
-    h = jax.nn.gelu(jnp.einsum(
-        "ecd,edf->ecf", expert_in, w_e1.astype(x.dtype),
+    # (G, gs, E, cap) x (G, gs, D) -> experts see (E, G*cap, D)
+    expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(x.dtype), xs)
+    expert_in = expert_in.transpose(1, 0, 2, 3).reshape(e, ng * cap, d)
+    up = jnp.einsum(
+        "end,edf->enf", expert_in, w_e1.astype(x.dtype),
         preferred_element_type=jnp.float32,
-    )).astype(x.dtype)
+    )
+    if w_gate is not None:
+        gate = jnp.einsum(
+            "end,edf->enf", expert_in, w_gate.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
+        h = (jax.nn.silu(gate) * up).astype(x.dtype)
+    else:
+        h = jax.nn.gelu(up).astype(x.dtype)
     expert_out = jnp.einsum(
-        "ecf,efd->ecd", h, w_e2.astype(x.dtype),
+        "enf,efd->end", h, w_e2.astype(x.dtype),
         preferred_element_type=jnp.float32,
-    )
+    )  # (E, G*cap, D) fp32
+    expert_out = expert_out.reshape(e, ng, cap, d).transpose(1, 0, 2, 3)
     out = jnp.einsum(
-        "sec,ecd->sd", combine.astype(jnp.float32), expert_out
+        "gsec,gecd->gsd", combine.astype(jnp.float32), expert_out
     ).astype(x.dtype)
 
-    # Switch load-balancing loss on top-1 assignment
-    f = jnp.mean(onehots[0], axis=0)      # fraction routed to each expert
-    p = jnp.mean(probs, axis=0)           # mean router prob per expert
+    # Switch load-balancing loss on top-1 assignment, over all tokens
+    f = jnp.mean(top1.reshape(s, e), axis=0)   # fraction routed per expert
+    p = jnp.mean(probs.reshape(s, e), axis=0)  # mean router prob per expert
     aux = e * jnp.sum(f * p)
     return out.reshape(b, t, d), aux

@@ -7,9 +7,13 @@ named ``jax.sharding.Mesh`` over all addressable devices carries every
 parallelism axis, and XLA compiles the collectives (psum over ICI within a
 slice, DCN across hosts) directly into the training step:
 
+  pp    pipeline parallelism (GPipe stages over the stacked layer axis,
+        parallel/pipeline.py)
   dp    pure data parallelism (the reference's only axis — grad all-reduce)
   fsdp  data parallelism + ZeRO-style parameter/optimizer sharding
         (BASELINE config #4: "pjit param sharding, DDP->GSPMD/FSDP analogue")
+  ep    expert parallelism for MoE (ops/moe.py); also shards the batch
+        outside expert layers, GShard-style
   tp    megatron-style tensor parallelism (column/row-split matmuls)
   sp    sequence/context parallelism for ring attention (long-context axis)
 
@@ -42,8 +46,7 @@ BATCH_AXES = ("dp", "fsdp", "ep")
 
 def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple[int, ...]:
     """Resolve -1 entries ("absorb remaining devices") and validate."""
-    dims = [getattr(cfg, "pp", 1), cfg.dp, cfg.fsdp, getattr(cfg, "ep", 1),
-            cfg.tp, cfg.sp]
+    dims = [cfg.pp, cfg.dp, cfg.fsdp, cfg.ep, cfg.tp, cfg.sp]
     if dims.count(-1) > 1:
         raise ValueError(f"at most one mesh axis may be -1, got {dims}")
     known = math.prod(d for d in dims if d != -1)
@@ -145,6 +148,7 @@ PARAM_RULES: dict[str, P] = {
     "w_router": P("pp", None, None),
     "w_e1": P("pp", "ep", "fsdp", "tp"),
     "w_e2": P("pp", "ep", "tp", "fsdp"),
+    "w_eg": P("pp", "ep", "fsdp", "tp"),
 }
 
 

@@ -36,7 +36,7 @@ from mingpt_distributed_tpu.utils.pytree import leaf_name
 #   no-decay: every bias, every norm scale/bias, token + positional embeddings
 _DECAY_NAMES = frozenset(
     {"wq", "wk", "wv", "wo", "w_fc", "w_proj", "w_gate", "w_up", "w_down",
-     "head", "w_router", "w_e1", "w_e2"}  # MoE router/experts are matmuls
+     "head", "w_router", "w_e1", "w_e2", "w_eg"}  # MoE router/experts are matmuls
 )
 _NO_DECAY_NAMES = frozenset(
     {

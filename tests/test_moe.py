@@ -62,6 +62,44 @@ def test_capacity_overflow_drops_not_crashes():
     assert float(jnp.min(norms)) == 0.0
 
 
+def test_switch_k1_router_gets_task_gradient():
+    """k=1 must scale expert output by the RAW router prob (Switch): with
+    renormalised gates the weight is identically 1 and the router would get
+    zero task-loss gradient — it could never learn to specialize."""
+    d, f, e = 8, 16, 4
+    x = jax.random.normal(jax.random.key(0), (1, 32, d), jnp.float32)
+    params = {
+        "wr": jax.random.normal(jax.random.key(1), (d, e)),
+        "w1": jax.random.normal(jax.random.key(2), (e, d, f)) * 0.2,
+        "w2": jax.random.normal(jax.random.key(3), (e, f, d)) * 0.2,
+    }
+
+    def task_loss(p):  # NO aux term — gradient must come from the task
+        out, _ = moe.moe_mlp(x, p["wr"], p["w1"], p["w2"],
+                             top_k=1, capacity_factor=2.0)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(task_loss)(params)
+    assert float(jnp.abs(g["wr"]).max()) > 0
+
+
+def test_grouped_dispatch_linear_memory():
+    """Groups bound the one-hot dispatch to O(group * S), not O(S^2): the
+    routed result must be identical whether S spans one group or many (with
+    non-binding capacity)."""
+    d, f, e = 8, 16, 2
+    w1 = jax.random.normal(jax.random.key(2), (e, d, f)) * 0.2
+    w2 = jax.random.normal(jax.random.key(3), (e, f, d)) * 0.2
+    wr = jax.random.normal(jax.random.key(1), (d, e))
+    x = jax.random.normal(jax.random.key(0), (2, moe.MAX_GROUP, d))
+    out, _ = moe.moe_mlp(x, wr, w1, w2, top_k=1, capacity_factor=2.0)
+    # same tokens as a single smaller batch (one group) must agree
+    out_small, _ = moe.moe_mlp(x[:1], wr, w1, w2, top_k=1,
+                               capacity_factor=2.0)
+    np.testing.assert_allclose(np.asarray(out[:1]), np.asarray(out_small),
+                               rtol=1e-5, atol=1e-5)
+
+
 def test_gradients_flow_to_router_and_experts():
     d, f, e = 8, 16, 4
     x = jax.random.normal(jax.random.key(0), (1, 32, d), jnp.float32)
@@ -194,11 +232,49 @@ def test_moe_trainer_learns(tmp_path, eight_devices):
 
 
 def test_moe_config_validation():
-    with pytest.raises(ConfigError, match="swiglu"):
-        GPTConfig.make(
-            n_layer=2, n_head=2, n_embd=32, n_experts=2, swiglu=True,
-            rmsnorm=True, rope=True,
-        )
     with pytest.raises(ConfigError, match="moe_top_k"):
         GPTConfig.make(n_layer=2, n_head=2, n_embd=32, n_experts=2,
                        moe_top_k=3)
+
+
+def test_swiglu_single_expert_equals_dense_swiglu():
+    """Mixtral-style SwiGLU experts: E=1 must reduce to the dense SwiGLU MLP
+    with the same weights."""
+    d, f = 16, 32
+    x = jax.random.normal(jax.random.key(0), (2, 8, d), jnp.float32)
+    wg = jax.random.normal(jax.random.key(1), (d, f)) * 0.2
+    wu = jax.random.normal(jax.random.key(2), (d, f)) * 0.2
+    wd = jax.random.normal(jax.random.key(3), (f, d)) * 0.2
+    out, _ = moe.moe_mlp(
+        x, jnp.zeros((d, 1)), wu[None], wd[None], top_k=1,
+        capacity_factor=2.0, w_gate=wg[None],
+    )
+    want = L.mlp_swiglu(x, wg, wu, wd)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_mixtral_style_model_trains_and_generates():
+    """llama toggles + MoE together (the Mixtral family): forward, loss,
+    grads, and KV-cached generation parity."""
+    from tests.test_generate import dense_greedy
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=50, block_size=32,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+        rope=True, swiglu=True, rmsnorm=True, n_kv_head=1, tie_weights=True,
+        n_experts=2, moe_top_k=2, moe_capacity_factor=2.0,
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    assert params["blocks"]["w_eg"].shape == params["blocks"]["w_e1"].shape
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 50)
+    _, loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    assert np.isfinite(float(loss))
+    g = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens)[1]
+    )(params)
+    assert float(jnp.abs(g["blocks"]["w_eg"]).max()) > 0
+    prompt = jax.random.randint(jax.random.key(2), (1, 4), 0, 50)
+    want = dense_greedy(params, cfg, prompt, 6)
+    got = gen.generate(params, cfg, prompt, 6)
+    np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
