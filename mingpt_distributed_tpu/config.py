@@ -127,6 +127,12 @@ class GPTConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01  # load-balancing loss weight
+    # Cross-entropy head chunking: >1 splits the LM-head matmul + softmax
+    # into this many sequence chunks under jax.checkpoint, so the (B, T, V)
+    # fp32 logits tensor — the dominant activation at GPT-2 vocab sizes —
+    # never materialises whole. 0/1 = dense (reference semantics; identical
+    # loss either way). Ignored when T is not divisible by it.
+    loss_chunks: int = 8
 
     @classmethod
     def make(cls, **kwargs: Any) -> "GPTConfig":

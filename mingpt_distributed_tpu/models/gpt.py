@@ -226,12 +226,16 @@ def forward(
     rng: Optional[jax.Array] = None,
     deterministic: bool = True,
     mesh=None,  # required only for attention="ring" (see _attention_dispatch)
-) -> Tuple[jax.Array, Optional[jax.Array]]:
+    return_logits: bool = True,
+) -> Tuple[Optional[jax.Array], Optional[jax.Array]]:
     """Full forward pass -> (logits (B, T, V) float32, loss or None).
 
     Same contract as the reference's GPT.forward (model.py:309-320): returns
     logits always, plus mean cross-entropy over targets != -1 when targets
-    are given.
+    are given. ``return_logits=False`` (the trainer's loss-only mode)
+    returns ``(None, loss)`` and — when ``cfg.loss_chunks`` applies — never
+    materialises the (B, T, V) logits at all: the LM head + softmax run per
+    sequence chunk under jax.checkpoint (see chunked_cross_entropy).
     """
     b, t = tokens.shape
     if t > cfg.block_size:  # static shape — checked at trace time (B3 intent)
@@ -320,17 +324,41 @@ def forward(
 
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)
     w_head = params["wte"].T if cfg.tie_weights else params["head"]
-    logits = jnp.einsum(
-        "btd,dv->btv", x, w_head.astype(x.dtype),
-        preferred_element_type=jnp.float32,
+    # snap the chunk count to the largest divisor of T <= loss_chunks, so an
+    # awkward block_size degrades to fewer/larger chunks, not silently to
+    # the dense (B, T, V) materialisation the feature exists to avoid
+    nc = max(
+        (d for d in range(1, cfg.loss_chunks + 1) if t % d == 0),
+        default=1,
     )
+    chunked = targets is not None and not return_logits and nc > 1
+
+    logits = None
+    if not chunked:
+        logits = jnp.einsum(
+            "btd,dv->btv", x, w_head.astype(x.dtype),
+            preferred_element_type=jnp.float32,
+        )
 
     loss = None
     if targets is not None:
-        loss = cross_entropy(logits, targets)
+        if chunked:
+            # loss-only mode: the LM head + softmax run per sequence chunk
+            # under jax.checkpoint, so the full (B, T, V) fp32 logits
+            # (1.6 GB at B=8/T=1024/V=50257 — the tensor that caps the
+            # per-chip batch) never materialises, forward or backward.
+            # When logits are requested they exist anyway, so dense CE
+            # costs no extra memory — no chunking in that case.
+            loss = chunked_cross_entropy(
+                x, w_head.astype(x.dtype), targets, nc
+            )
+        else:
+            loss = cross_entropy(logits, targets)
         if cfg.n_experts:
             # per-layer-mean load-balancing loss (Switch Transformer)
             loss = loss + cfg.moe_aux_weight * moe_aux / nl
+    if not return_logits:
+        logits = None
     return logits, loss
 
 
@@ -342,6 +370,43 @@ def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     return -(ll * valid).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def chunked_cross_entropy(
+    x: jax.Array, w_head: jax.Array, targets: jax.Array, n_chunks: int
+) -> jax.Array:
+    """Same math as ``cross_entropy(x @ w_head, targets)``, but the head
+    matmul + log-softmax run per sequence chunk under ``jax.checkpoint``:
+    peak logits memory is (B, T/n_chunks, V) and the backward recomputes
+    each chunk's logits instead of storing them. Trades one extra head
+    matmul (in backward) for ~2x(B,T,V) fp32 of HBM — the dominant
+    activation for GPT-2-sized vocabularies.
+    """
+    b, t, d = x.shape
+    c = t // n_chunks
+    xs = x.reshape(b, n_chunks, c, d).swapaxes(0, 1)  # (n, B, c, D)
+    ts = targets.reshape(b, n_chunks, c).swapaxes(0, 1)
+
+    def body(carry, xt):
+        xc, tc = xt
+        logits = jnp.einsum(
+            "bcd,dv->bcv", xc, w_head, preferred_element_type=jnp.float32
+        )
+        valid = tc != -1
+        safe = jnp.where(valid, tc, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return (
+            carry[0] - (ll * valid).sum(),
+            carry[1] + valid.sum(),
+        ), None
+
+    (tot, cnt), _ = jax.lax.scan(
+        jax.checkpoint(body),
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (xs, ts),
+    )
+    return tot / jnp.maximum(cnt, 1)
 
 
 # ---------------------------------------------------------------------------

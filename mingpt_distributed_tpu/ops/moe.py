@@ -41,14 +41,18 @@ import jax
 import jax.numpy as jnp
 
 # Max tokens routed as one group; actual group size is the largest divisor
-# of S at most this (S itself for small inputs).
+# of S at most this (S itself for small inputs). Groups below MIN_GROUP
+# would collapse per-group expert capacity toward 1 and silently drop most
+# routes — if S has no divisor in [MIN_GROUP, MAX_GROUP], route it as one
+# big group instead (more dispatch memory, correct routing).
 MAX_GROUP = 1024
+MIN_GROUP = 128
 
 
 def _group_size(s: int) -> int:
     if s <= MAX_GROUP:
         return s
-    for g in range(MAX_GROUP, 0, -1):
+    for g in range(MAX_GROUP, MIN_GROUP - 1, -1):
         if s % g == 0:
             return g
     return s

@@ -38,7 +38,76 @@ NEG_INF = -1e30
 
 
 def _ring_shard(q, k, v, *, axis_name: str, scale: float):
-    """Per-shard ring attention. q/k/v: (b, c, h, hd) local chunks."""
+    """Per-shard ring attention. q/k/v: (b, c, h, hd) local chunks.
+
+    Dispatch: when the local chunk is tileable, each hop's attention runs
+    through the Pallas flash kernel (ops/flash_attention.flash_with_lse) and
+    the per-chunk (out, lse) pairs merge exactly — MXU-rate matmuls and
+    O(block) VMEM inside the chunk, ppermute across chunks. Otherwise the
+    fp32 einsum fold below is the oracle.
+    """
+    from mingpt_distributed_tpu.ops import flash_attention as fa
+
+    block = fa.supported_block(q.shape[1])
+    if block is not None:
+        return _ring_shard_flash(
+            q, k, v, axis_name=axis_name, scale=scale, block=block
+        )
+    return _ring_shard_einsum(q, k, v, axis_name=axis_name, scale=scale)
+
+
+def _ring_shard_flash(q, k, v, *, axis_name: str, scale: float, block: int):
+    """Flash-kernel ring: the diagonal chunk runs the causal kernel; every
+    rotated chunk runs the non-causal kernel and is folded via its
+    log-sum-exp (future chunks fold with lse = -inf, i.e. exactly zero
+    weight). Same math as the einsum fold, restated per chunk:
+    out = sum_i exp(lse_i - LSE) * o_i with LSE = logsumexp_i(lse_i).
+    """
+    from mingpt_distributed_tpu.ops import flash_attention as fa
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, c, h, hd = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, c, hd)
+
+    qb = to_bh(q)
+    # step 0: own (diagonal) chunk, causal — every query row sees >= 1 key,
+    # so the running state starts NaN-free
+    o0, lse0 = fa.flash_with_lse(qb, to_bh(k), to_bh(v), scale, block, True)
+    m0 = lse0  # (bh, c, 1) fp32
+    l0 = jnp.ones_like(lse0)  # exp(lse0 - m0)
+    acc0 = o0.astype(jnp.float32)
+
+    def body(carry, i):
+        m, l, acc, kc, vc = carry
+        # rotate K/V one hop around the ring (ICI neighbour exchange)
+        shift = [(j, (j + 1) % n) for j in range(n)]
+        kc = jax.lax.ppermute(kc, axis_name, shift)
+        vc = jax.lax.ppermute(vc, axis_name, shift)
+        src = (idx - i) % n  # origin device of the chunk we now hold
+        oi, lsei = fa.flash_with_lse(
+            qb, to_bh(kc), to_bh(vc), scale, block, False
+        )
+        # strictly-past chunks contribute; future chunks fold with zero
+        # weight (finite NEG_INF keeps exp() well-defined)
+        lsei = jnp.where(src < idx, lsei, NEG_INF)
+        m_new = jnp.maximum(m, lsei)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.exp(lsei - m_new)
+        l = l * alpha + w
+        acc = acc * alpha + w * oi.astype(jnp.float32)
+        return (m_new, l, acc, kc, vc), None
+
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        body, (m0, l0, acc0, k, v), jnp.arange(1, n)
+    )
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return out.reshape(b, h, c, hd).transpose(0, 2, 1, 3)
+
+
+def _ring_shard_einsum(q, k, v, *, axis_name: str, scale: float):
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, c, h, hd = q.shape

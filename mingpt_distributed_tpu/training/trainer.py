@@ -76,6 +76,7 @@ def make_train_step(
                 rng=None if deterministic else rng,
                 deterministic=deterministic,
                 mesh=mesh,
+                return_logits=False,  # loss-only: enables the chunked head
             )
             return loss
 
@@ -96,7 +97,10 @@ def make_train_step(
 def make_eval_step(cfg: GPTConfig, mesh=None):
     def eval_step(state: TrainState, batch):
         x, y = batch
-        _, loss = gpt.forward(state["params"], x, cfg, targets=y, mesh=mesh)
+        _, loss = gpt.forward(
+            state["params"], x, cfg, targets=y, mesh=mesh,
+            return_logits=False,
+        )
         return loss
 
     return eval_step

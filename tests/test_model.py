@@ -139,3 +139,69 @@ def test_gradients_flow_everywhere():
         if float(jnp.abs(leaf).max()) == 0.0
     ]
     assert not zero_leaves, f"dead params: {zero_leaves}"
+
+
+def test_chunked_cross_entropy_matches_dense():
+    """loss_chunks>1 must be loss- and grad-equivalent to the dense head
+    (it is the same math, computed per sequence chunk under jax.checkpoint
+    so the (B, T, V) logits never materialise whole)."""
+    import dataclasses
+
+    cfg_d = dataclasses.replace(small_cfg(), loss_chunks=0)
+    cfg_c = dataclasses.replace(small_cfg(), loss_chunks=4)
+    params = gpt.init(jax.random.key(0), cfg_d)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 65)
+    tgt = tokens.at[0, :3].set(-1)  # exercise ignore_index in both paths
+
+    _, l_d = gpt.forward(params, tokens, cfg_d, targets=tgt)
+    _, l_c = gpt.forward(params, tokens, cfg_c, targets=tgt,
+                         return_logits=False)
+    assert abs(float(l_d) - float(l_c)) < 1e-6
+
+    g_d = jax.grad(lambda p: gpt.forward(p, tokens, cfg_d, targets=tgt)[1])(params)
+    g_c = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg_c, targets=tgt,
+                              return_logits=False)[1]
+    )(params)
+    for a, b in zip(jax.tree.leaves(g_d), jax.tree.leaves(g_c)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def test_chunked_cross_entropy_indivisible_t_snaps_to_divisor():
+    """loss_chunks=7 with T=16 snaps to 4 chunks (largest divisor <= 7) —
+    never silently dense — and the loss is unchanged; a prime T (no
+    divisor > 1) degrades to the dense head, also unchanged."""
+    import dataclasses
+
+    cfg = dataclasses.replace(small_cfg(), loss_chunks=7)
+    cfg_dense = dataclasses.replace(small_cfg(), loss_chunks=0)
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 65)
+    _, loss = gpt.forward(params, tokens, cfg, targets=tokens,
+                          return_logits=False)
+    _, want = gpt.forward(params, tokens, cfg_dense, targets=tokens)
+    assert abs(float(loss) - float(want)) < 1e-6
+
+    cfg13 = dataclasses.replace(
+        small_cfg(block_size=13), loss_chunks=8)
+    cfg13_dense = dataclasses.replace(
+        small_cfg(block_size=13), loss_chunks=0)
+    params13 = gpt.init(jax.random.key(0), cfg13)
+    toks13 = jax.random.randint(jax.random.key(1), (2, 13), 0, 65)
+    _, l13 = gpt.forward(params13, toks13, cfg13, targets=toks13,
+                         return_logits=False)
+    _, w13 = gpt.forward(params13, toks13, cfg13_dense, targets=toks13)
+    assert abs(float(l13) - float(w13)) < 1e-6
+
+
+def test_loss_only_mode_returns_no_logits():
+    """return_logits=False -> (None, loss); loss matches the dense path."""
+    cfg = small_cfg()
+    params = gpt.init(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 65)
+    logits, loss = gpt.forward(params, tokens, cfg, targets=tokens,
+                               return_logits=False)
+    assert logits is None
+    logits_d, loss_d = gpt.forward(params, tokens, cfg, targets=tokens)
+    assert logits_d.shape == (2, 16, 65)
+    assert abs(float(loss) - float(loss_d)) < 1e-6
