@@ -54,6 +54,15 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
                           rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
                           vocab_size=128256, block_size=8192, ffn_mult=3.5,
                           rope_theta=500000.0),  # Llama 3 base, not the 1e4 default
+    # Mixtral-style sparse MoE presets (SwiGLU experts, top-2 routing,
+    # expert axis shards over the mesh's ep axis — ops/moe.py).
+    "mixtral-tiny":  dict(n_layer=4,  n_head=4,  n_embd=256,  n_kv_head=2,
+                          rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
+                          n_experts=4, moe_top_k=2),
+    "mixtral-8x7b":  dict(n_layer=32, n_head=32, n_embd=4096, n_kv_head=8,
+                          rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
+                          vocab_size=32000, block_size=8192, ffn_mult=3.5,
+                          rope_theta=1000000.0, n_experts=8, moe_top_k=2),
 }
 
 
@@ -302,6 +311,7 @@ class TrainerConfig:
     eval_every: int = 1           # epochs between eval passes
     eval_batches: Optional[int] = None  # cap eval batches; None = full pass
     metrics_jsonl: Optional[str] = None  # JSONL metrics sink (§5.5 upgrade)
+    tensorboard_dir: Optional[str] = None  # TensorBoard sink (§5.5 upgrade)
     prefetch: int = 2  # background batch-prefetch depth; 0 disables
     # debug aids (SURVEY §5.2 — the reference shipped a real checkpoint race
     # and had no sanitizers): jax_debug_nans traps the first NaN/Inf inside

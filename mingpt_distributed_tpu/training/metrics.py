@@ -52,13 +52,16 @@ def flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
 
 
 class MetricsLogger:
-    """stdout + optional JSONL sink; rate/MFU computed over log windows."""
+    """stdout + optional JSONL + optional TensorBoard sinks; rate/MFU
+    computed over log windows (SURVEY §5.5's prescription — the reference
+    logs per-rank unreduced loss via print only, trainer.py:144-147)."""
 
     def __init__(
         self,
         cfg: GPTConfig,
         *,
         jsonl_path: Optional[str] = None,
+        tensorboard_dir: Optional[str] = None,
         n_chips: int = 1,
         enabled: bool = True,
     ):
@@ -68,6 +71,14 @@ class MetricsLogger:
         self._jsonl: Optional[TextIO] = None
         if enabled and jsonl_path:
             self._jsonl = open(jsonl_path, "a")
+        self._tb = None
+        if enabled and tensorboard_dir:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(log_dir=tensorboard_dir)
+            except Exception as e:  # optional dep — degrade to other sinks
+                print(f"tensorboard sink unavailable ({e}); continuing")
         self._last_time: Optional[float] = None
         self._last_step: Optional[int] = None
         self._peak = peak_flops_per_chip()
@@ -97,9 +108,16 @@ class MetricsLogger:
             if self._jsonl:
                 self._jsonl.write(json.dumps(rec) + "\n")
                 self._jsonl.flush()
+            if self._tb:
+                for k, v in rec.items():
+                    if k != "step":
+                        self._tb.add_scalar(k, v, step)
         return rec
 
     def close(self) -> None:
         if self._jsonl:
             self._jsonl.close()
             self._jsonl = None
+        if self._tb:
+            self._tb.close()
+            self._tb = None

@@ -252,6 +252,9 @@ class GPTTrainer:
         self.metrics = MetricsLogger(
             gpt_config,
             jsonl_path=config.metrics_jsonl if self.is_writer else None,
+            tensorboard_dir=(
+                config.tensorboard_dir if self.is_writer else None
+            ),
             n_chips=len(jax.devices()),
             enabled=self.is_writer,
         )

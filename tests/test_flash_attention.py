@@ -137,3 +137,61 @@ def test_model_forward_with_flash_matches_einsum():
     lf, _ = gpt.forward(params, tokens, cfg_f, targets=tokens)
     np.testing.assert_allclose(np.asarray(lf), np.asarray(le),
                                rtol=2e-4, atol=2e-4)
+
+
+def _dense_noncausal(q, k, v):
+    """Non-causal reference: softmax(QK^T/sqrt(hd))V + its log-sum-exp."""
+    hd = q.shape[-1]
+    s = jnp.einsum("bthd,bshd->bhts", q, k,
+                   preferred_element_type=jnp.float32) / jnp.sqrt(
+                       jnp.asarray(hd, jnp.float32))
+    lse = jax.nn.logsumexp(s, axis=-1)  # (B, H, T)
+    out = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1), v)
+    return out.astype(q.dtype), lse
+
+
+def test_flash_with_lse_noncausal_parity():
+    """The non-causal kernel mode (ring attention's off-diagonal hops):
+    out and lse both match the dense reference."""
+    import math
+
+    b, t, h, hd = 2, 256, 2, 32
+    q, k, v = qkv(b=b, t=t, h=h, hd=hd, seed=5)
+    want_out, want_lse = _dense_noncausal(q, k, v)
+
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
+    out, lse = flash.flash_with_lse(
+        to_bh(q), to_bh(k), to_bh(v), 1.0 / math.sqrt(hd), 128, False
+    )
+    out = out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, t)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want_out),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want_lse),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_with_lse_cotangent():
+    """Gradients that flow through BOTH outputs (out and lse) match the
+    dense reference — the lse cotangent folds into the delta term."""
+    import math
+
+    b, t, h, hd = 1, 128, 2, 16
+    q, k, v = qkv(b=b, t=t, h=h, hd=hd, seed=9)
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
+
+    def loss_flash(q, k, v):
+        out, lse = flash.flash_with_lse(
+            to_bh(q), to_bh(k), to_bh(v), 1.0 / math.sqrt(hd), 128, False
+        )
+        return (out.astype(jnp.float32) ** 2).sum() + (lse * 0.3).sum()
+
+    def loss_dense(q, k, v):
+        out, lse = _dense_noncausal(q, k, v)
+        return (out.astype(jnp.float32) ** 2).sum() + (lse * 0.3).sum()
+
+    g_f = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_f, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=1e-4, atol=1e-4)

@@ -1,0 +1,51 @@
+"""Metrics/observability (SURVEY §5.5): window rates, MFU model, sinks."""
+
+import glob
+import json
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.training.metrics import (
+    MetricsLogger,
+    flops_per_token,
+)
+
+
+def small_cfg():
+    return GPTConfig.make(n_layer=2, n_head=2, n_embd=32, vocab_size=64,
+                          block_size=16)
+
+
+def test_rate_and_mfu_fields_appear_on_second_log():
+    log = MetricsLogger(small_cfg(), n_chips=2)
+    r1 = log.log_step(1, tokens_per_step=512, seq_len=16, scalars={"loss": 3.0})
+    assert "tokens_per_sec" not in r1  # no window yet
+    r2 = log.log_step(2, tokens_per_step=512, seq_len=16, scalars={"loss": 2.9})
+    assert r2["tokens_per_sec"] > 0
+    assert r2["tokens_per_sec_per_chip"] == r2["tokens_per_sec"] / 2
+    log.close()
+
+
+def test_flops_per_token_scales_with_depth():
+    a = flops_per_token(small_cfg(), 16)
+    cfg_deep = GPTConfig.make(n_layer=4, n_head=2, n_embd=32, vocab_size=64,
+                              block_size=16)
+    assert flops_per_token(cfg_deep, 16) > a
+
+
+def test_jsonl_sink(tmp_path):
+    p = tmp_path / "m.jsonl"
+    log = MetricsLogger(small_cfg(), jsonl_path=str(p))
+    log.log_step(1, 512, 16, {"loss": 3.0})
+    log.log_step(2, 512, 16, {"loss": 2.5})
+    log.close()
+    recs = [json.loads(l) for l in p.read_text().splitlines()]
+    assert [r["step"] for r in recs] == [1, 2]
+    assert recs[1]["loss"] == 2.5
+
+
+def test_tensorboard_sink(tmp_path):
+    log = MetricsLogger(small_cfg(), tensorboard_dir=str(tmp_path / "tb"))
+    log.log_step(1, 512, 16, {"loss": 3.0})
+    log.log_step(2, 512, 16, {"loss": 2.5})
+    log.close()
+    assert glob.glob(str(tmp_path / "tb" / "events.out.tfevents.*"))

@@ -278,3 +278,21 @@ def test_mixtral_style_model_trains_and_generates():
     want = dense_greedy(params, cfg, prompt, 6)
     got = gen.generate(params, cfg, prompt, 6)
     np.testing.assert_array_equal(np.asarray(want), np.asarray(got))
+
+
+def test_mixtral_preset_forward():
+    """The mixtral presets resolve and run: SwiGLU experts + top-2 routing +
+    rope/rmsnorm/GQA composed via one model_type string."""
+    import dataclasses
+
+    from mingpt_distributed_tpu.config import GPTConfig
+
+    cfg = GPTConfig.make(model_type="mixtral-tiny", block_size=16,
+                         vocab_size=64, dtype="float32",
+                         embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0)
+    assert cfg.n_experts == 4 and cfg.moe_top_k == 2 and cfg.swiglu
+    params = gpt.init(jax.random.key(0), cfg)
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+    logits, loss = gpt.forward(params, toks, cfg, targets=toks)
+    assert logits.shape == (2, 16, 64)
+    assert np.isfinite(float(loss))
