@@ -155,6 +155,27 @@ def _attention_dispatch(cfg: GPTConfig, mesh=None):
     raise NotImplementedError(f"attention={cfg.attention!r}")
 
 
+def _manual_sp_attention(cfg: GPTConfig):
+    """Per-shard sequence-parallel attention for use *inside* an enclosing
+    shard_map region (the pipeline): the ring / Ulysses shard bodies run
+    directly over the manual ``sp`` axis — their public wrappers would try
+    to open a nested shard_map, which JAX forbids."""
+    from mingpt_distributed_tpu.parallel import ring_attention, ulysses
+
+    def fn(q, k, v, *, attn_pdrop=0.0, dropout_key=None, deterministic=True):
+        del attn_pdrop, dropout_key, deterministic  # gated by the caller
+        h, hd = q.shape[2], q.shape[3]
+        k2 = attn_ops.repeat_kv(k, h // k.shape[2])
+        v2 = attn_ops.repeat_kv(v, h // v.shape[2])
+        if cfg.attention == "ring":
+            return ring_attention._ring_shard(
+                q, k2, v2, axis_name="sp", scale=1.0 / math.sqrt(hd)
+            )
+        return ulysses._ulysses_shard(q, k2, v2, axis_name="sp")
+
+    return fn
+
+
 def _norm(x, scale, bias, cfg: GPTConfig):
     if cfg.rmsnorm:
         return L.rms_norm(x, scale, eps=cfg.norm_eps)
@@ -169,6 +190,7 @@ def _block(
     drop_key: Optional[jax.Array],
     deterministic: bool,
     mesh=None,
+    attn_fn=None,  # override (e.g. manual sp attention inside the pipeline)
 ) -> Tuple[jax.Array, jax.Array]:
     """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
@@ -189,7 +211,7 @@ def _block(
         cos, sin = rope
         q = attn_ops.apply_rope(q, cos, sin)
         k = attn_ops.apply_rope(k, cos, sin)
-    att = _attention_dispatch(cfg, mesh)(
+    att = (attn_fn or _attention_dispatch(cfg, mesh))(
         q, k, v,
         attn_pdrop=cfg.attn_pdrop,
         dropout_key=k_attn,
@@ -285,38 +307,78 @@ def forward(
         # see every traced value it uses.
         from mingpt_distributed_tpu.parallel import pipeline
 
-        if cfg.attention in ("ring", "ulysses"):
+        sp = mesh.shape.get("sp", 1)
+        seq_sharded = cfg.attention in ("ring", "ulysses") and sp > 1
+        if seq_sharded:
+            # inside the manual region there is no oracle fallback, so the
+            # shard bodies' applicability conditions become hard errors
+            if not (deterministic or cfg.attn_pdrop == 0.0):
+                raise NotImplementedError(
+                    "attention dropout is not supported with sequence "
+                    "parallelism inside pipeline stages; set attn_pdrop=0"
+                )
+            if t % sp:
+                raise ValueError(f"T={t} not divisible by sp={sp} under pp")
+            if cfg.attention == "ulysses" and cfg.n_head % sp:
+                raise ValueError(
+                    f"ulysses needs n_head % sp == 0 (got {cfg.n_head} % {sp})"
+                )
+        if cfg.n_experts and mesh.shape.get("ep", 1) > 1:
             raise NotImplementedError(
-                "sequence-parallel attention inside pipeline stages is not "
-                "supported; use attention='einsum'/'flash' with pp > 1"
+                "expert (ep) sharding inside pipeline stages is not "
+                "supported: stage entry gathers each stage's params, so use "
+                "ep=1 with pp>1 (experts replicate) or pp=1 with ep>1"
             )
-        if cfg.n_experts:
-            raise NotImplementedError(
-                "MoE inside pipeline stages is not supported yet; use "
-                "pp=1 with n_experts > 0 (ep shards the experts instead)"
-            )
+        manual_attn = _manual_sp_attention(cfg) if seq_sharded else None
 
         def apply_stack(x_mb, xs_local, consts, mb_idx):
-            rope_c = consts if cfg.rope else None
+            if cfg.rope:
+                cos, sin = consts
+                if seq_sharded:
+                    # this shard's rows of the (global-T) rope tables
+                    c = x_mb.shape[1]
+                    i0 = jax.lax.axis_index("sp") * c
+                    cos = jax.lax.dynamic_slice_in_dim(cos, i0, c)
+                    sin = jax.lax.dynamic_slice_in_dim(sin, i0, c)
+                rope_c = (cos, sin)
+            else:
+                rope_c = None
+
+            def run(carry, blk, key):
+                xc, aux = carry
+                y, a = _block(xc, blk, cfg, rope_c, key, deterministic,
+                              attn_fn=manual_attn)
+                return (y, aux + a)
+
             if deterministic:
                 def body_pp(carry, blk):
-                    return _block(carry, blk, cfg, rope_c, None, True)[0], None
+                    return run(carry, blk, None), None
             else:
                 def body_pp(carry, scanned):
                     blk, key = scanned
                     # decorrelate dropout across microbatches: the same
                     # layer key is applied to every microbatch otherwise
                     key = jax.random.fold_in(key, mb_idx)
-                    return _block(carry, blk, cfg, rope_c, key, False)[0], None
+                    if seq_sharded:
+                        # ...and across sequence shards: each sp shard
+                        # holds different positions of the same tensor
+                        key = jax.random.fold_in(
+                            key, jax.lax.axis_index("sp")
+                        )
+                    return run(carry, blk, key), None
             step_pp = jax.checkpoint(body_pp) if cfg.remat else body_pp
-            y, _ = jax.lax.scan(step_pp, x_mb, xs_local)
-            return y
+            (y, aux), _ = jax.lax.scan(
+                step_pp, (x_mb, jnp.zeros((), jnp.float32)), xs_local
+            )
+            return y, aux
 
-        x = pipeline.pipeline_blocks(
+        # pipeline aux = sum over layers, averaged over microbatches and
+        # batch shards — the same quantity the single-device scan carries
+        x, moe_aux = pipeline.pipeline_blocks(
             x, xs, rope if cfg.rope else (), apply_stack, mesh,
             n_microbatches=cfg.pp_microbatches,
+            seq_sharded=seq_sharded,
         )
-        moe_aux = jnp.zeros((), jnp.float32)
     else:
         (x, moe_aux), _ = jax.lax.scan(
             step, (x, jnp.zeros((), jnp.float32)), xs

@@ -17,17 +17,24 @@ amortise. The whole schedule is one ``lax.scan`` inside one ``shard_map``,
 so it is reverse-differentiable as-is: autodiff transposes ppermute into the
 reverse hop and the backward pass runs the mirror-image pipeline.
 
-Composition: pp composes with dp/fsdp batch sharding (specs below keep the
-batch split over BATCH_AXES inside the region). Layer-granular tensor/
-sequence parallelism inside a stage is not composed here — entering the
-manual region gathers each stage's params over fsdp/tp (ZeRO-style
-just-in-time gather; tp would need nested collectives the attention kernels
-don't expect under manual mesh axes).
+Composition:
+- pp x dp/fsdp: batch stays sharded over BATCH_AXES inside the region.
+- pp x sp (``seq_sharded=True``): activations stay sequence-sharded inside
+  the region too; the caller's ``apply_stack`` runs sequence-parallel
+  attention (ring / Ulysses per-shard bodies over the ``sp`` axis — legal
+  here because the pipeline's shard_map already manualises every mesh axis).
+- pp x MoE: ``apply_stack`` returns a per-stage aux (load-balancing) loss;
+  garbage warm-up/drain ticks are masked out, stages sum over ``pp`` and the
+  batch-ish axes average, reproducing the single-device aux semantics.
+  (Expert weights are gathered at stage entry like the rest of the stage's
+  params — ZeRO-style JIT gather — so combine pp with ep=1.)
+- Layer-granular tensor parallelism inside a stage is not composed here
+  (entering the manual region gathers each stage's params over fsdp/tp).
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -40,19 +47,23 @@ def pipeline_blocks(
     x: jax.Array,              # (B, T, D) activations (batch-sharded outside)
     xs: Any,                   # scanned-over pytree, leading global layer axis
     consts: Any,               # replicated extras (e.g. rope tables), pytree
-    apply_stack: Callable[[jax.Array, Any, Any], jax.Array],
+    apply_stack: Callable[[jax.Array, Any, Any, jax.Array], Tuple[jax.Array, jax.Array]],
     mesh: Mesh,
     *,
     n_microbatches: int = 0,
-) -> jax.Array:
+    seq_sharded: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
     """Apply all layers to ``x`` across pipeline stages.
 
-    ``apply_stack(x_mb, xs_local, consts, mb_idx)`` applies one stage's local
-    layer stack (n_layer/pp layers) to one microbatch; ``mb_idx`` is the
-    index of the microbatch being processed (fold it into any PRNG keys so
+    ``apply_stack(x_mb, xs_local, consts, mb_idx) -> (y_mb, aux)`` applies
+    one stage's local layer stack (n_layer/pp layers) to one microbatch and
+    returns its scalar aux loss (0 for dense MLPs); ``mb_idx`` is the index
+    of the microbatch being processed (fold it into any PRNG keys so
     stochastic ops like dropout decorrelate across microbatches).
-    Semantically equivalent to scanning over the full layer axis on one
-    device.
+    ``seq_sharded`` keeps the sequence dim sharded over ``sp`` inside the
+    region (apply_stack must then run sequence-parallel attention).
+    Returns (activations, aux) — semantically equivalent to scanning the
+    full layer axis on one device.
     """
     pp = mesh.shape.get("pp", 1)
     if pp == 1:
@@ -76,38 +87,50 @@ def pipeline_blocks(
         shift = [(i, (i + 1) % pp) for i in range(pp)]
 
         def tick(carry, t):
-            state, outs = carry
+            state, outs, aux_tot = carry
             inp = jax.lax.dynamic_index_in_dim(
                 mbs, jnp.clip(t, 0, m - 1), 0, keepdims=False
             )
             state = jnp.where(stage == 0, inp, state)
             # the microbatch this stage holds at tick t entered at t - stage
             mb_idx = jnp.clip(t - stage, 0, m - 1).astype(jnp.int32)
-            state = apply_stack(state, xs_local, consts_, mb_idx)
+            state, aux = apply_stack(state, xs_local, consts_, mb_idx)
+            # warm-up/drain ticks process zero-padding, not data — mask
+            # their aux out (outputs are filtered by the banking below)
+            valid = (t >= stage) & (t - stage < m)
+            aux_tot = aux_tot + jnp.where(valid, aux, 0.0)
             # bank stage pp-1's finished microbatch (index t - pp + 1)
             oidx = jnp.maximum(t - (pp - 1), 0)
             prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
             bank = jnp.where((stage == pp - 1) & (t >= pp - 1), state, prev)
             outs = jax.lax.dynamic_update_index_in_dim(outs, bank, oidx, 0)
             state = jax.lax.ppermute(state, "pp", shift)
-            return (state, outs), None
+            return (state, outs, aux_tot), None
 
-        (_, outs), _ = jax.lax.scan(
-            tick, (state, outs), jnp.arange(m + pp - 1)
+        (_, outs, aux_tot), _ = jax.lax.scan(
+            tick,
+            (state, outs, jnp.zeros((), jnp.float32)),
+            jnp.arange(m + pp - 1),
         )
         # results live on the last stage; broadcast so every stage returns
         # the full activations (head/loss then run replicated over pp)
         outs = jax.lax.psum(
             jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), "pp"
         )
-        return outs.reshape(x_local.shape)
+        # aux: sum over stages (each holds different layers), mean over
+        # microbatches and over the batch-ish/sequence shards — the same
+        # estimator as the single-device full-batch mean
+        aux = jax.lax.psum(aux_tot, "pp") / m
+        aux = jax.lax.pmean(aux, BATCH_AXES + (("sp",) if seq_sharded else ()))
+        return outs.reshape(x_local.shape), aux
 
-    x_spec = P(BATCH_AXES, *([None] * (x.ndim - 1)))
+    seq_ax = "sp" if seq_sharded else None
+    x_spec = P(BATCH_AXES, seq_ax, *([None] * (x.ndim - 2)))
     fn = jax.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(x_spec, P("pp"), P()),
-        out_specs=x_spec,
+        out_specs=(x_spec, P()),
         check_vma=False,
     )
     return fn(x, xs, consts)

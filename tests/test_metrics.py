@@ -44,6 +44,9 @@ def test_jsonl_sink(tmp_path):
 
 
 def test_tensorboard_sink(tmp_path):
+    import pytest
+
+    pytest.importorskip("torch.utils.tensorboard")
     log = MetricsLogger(small_cfg(), tensorboard_dir=str(tmp_path / "tb"))
     log.log_step(1, 512, 16, {"loss": 3.0})
     log.log_step(2, 512, 16, {"loss": 2.5})
