@@ -312,6 +312,13 @@ class TrainerConfig:
     eval_batches: Optional[int] = None  # cap eval batches; None = full pass
     metrics_jsonl: Optional[str] = None  # JSONL metrics sink (§5.5 upgrade)
     tensorboard_dir: Optional[str] = None  # TensorBoard sink (§5.5 upgrade)
+    # Write msgpack snapshots from a background thread (the host copy is
+    # taken synchronously; serialization + object-store IO overlap training).
+    async_save: bool = False
+    # Accumulate gradients over this many micro-batches per optimizer step
+    # (one lax.scan inside the same jitted step): activation memory scales
+    # with batch_size/grad_accum_steps, semantics stay the full batch.
+    grad_accum_steps: int = 1
     prefetch: int = 2  # background batch-prefetch depth; 0 disables
     # debug aids (SURVEY §5.2 — the reference shipped a real checkpoint race
     # and had no sanitizers): jax_debug_nans traps the first NaN/Inf inside

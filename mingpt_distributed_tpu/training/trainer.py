@@ -59,9 +59,34 @@ state_shardings = mesh_lib.state_shardings
 
 
 def make_train_step(
-    cfg: GPTConfig, optimizer: optax.GradientTransformation, mesh=None
+    cfg: GPTConfig,
+    optimizer: optax.GradientTransformation,
+    mesh=None,
+    grad_accum: int = 1,
 ):
-    """forward+backward+update as one pure function of (state, batch, rng)."""
+    """forward+backward+update as one pure function of (state, batch, rng).
+
+    ``grad_accum > 1`` splits the step's batch into that many micro-batches
+    and accumulates gradients over a ``lax.scan`` before the single
+    optimizer update — activation memory scales with B/grad_accum while the
+    effective batch (and the loss/update semantics) stay the whole B.
+    Micro-batch losses/grads are averaged with equal weight (the standard
+    mean-of-means convention; exact whenever ignore_index masking is evenly
+    distributed, and exactly equal to grad_accum=1 when no -1 targets).
+    """
+
+    def loss_and_grads(params, x, y, rng, deterministic):
+        def loss_fn(p):
+            _, loss = gpt.forward(
+                p, x, cfg, targets=y,
+                rng=None if deterministic else rng,
+                deterministic=deterministic,
+                mesh=mesh,
+                return_logits=False,  # loss-only: enables the chunked head
+            )
+            return loss
+
+        return jax.value_and_grad(loss_fn)(params)
 
     def train_step(state: TrainState, batch, base_rng):
         x, y = batch
@@ -70,17 +95,42 @@ def make_train_step(
             cfg.embd_pdrop == 0.0 and cfg.resid_pdrop == 0.0 and cfg.attn_pdrop == 0.0
         )
 
-        def loss_fn(params):
-            _, loss = gpt.forward(
-                params, x, cfg, targets=y,
-                rng=None if deterministic else rng,
-                deterministic=deterministic,
-                mesh=mesh,
-                return_logits=False,  # loss-only: enables the chunked head
-            )
-            return loss
+        if grad_accum > 1:
+            b = x.shape[0]
+            if b % grad_accum:
+                raise ValueError(
+                    f"batch {b} not divisible by grad_accum={grad_accum}"
+                )
+            xs = x.reshape(grad_accum, b // grad_accum, *x.shape[1:])
+            ys = y.reshape(grad_accum, b // grad_accum, *y.shape[1:])
 
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"])
+            def acc(carry, mb):
+                loss_sum, g_sum, i = carry
+                x_mb, y_mb = mb
+                mb_rng = jax.random.fold_in(rng, i)
+                loss_i, g_i = loss_and_grads(
+                    state["params"], x_mb, y_mb, mb_rng, deterministic
+                )
+                g_sum = jax.tree.map(
+                    lambda a, bb: a + bb.astype(jnp.float32), g_sum, g_i
+                )
+                return (loss_sum + loss_i, g_sum, i + 1), None
+
+            g0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state["params"]
+            )
+            (loss_sum, g_sum, _), _ = jax.lax.scan(
+                acc,
+                (jnp.zeros((), jnp.float32), g0, jnp.asarray(0, jnp.int32)),
+                (xs, ys),
+            )
+            loss = loss_sum / grad_accum
+            grads = jax.tree.map(lambda g: g / grad_accum, g_sum)
+        else:
+            loss, grads = loss_and_grads(
+                state["params"], x, y, rng, deterministic
+            )
+
         updates, new_opt = optimizer.update(
             grads, state["opt_state"], state["params"]
         )
@@ -175,6 +225,14 @@ class GPTTrainer:
         self.ckpt_backend = (
             "msgpack" if self.snapshot_path.endswith(".msgpack") else "orbax"
         )
+        if config.async_save and self.ckpt_backend == "orbax":
+            import warnings
+
+            warnings.warn(
+                "async_save only applies to the msgpack backend; Orbax "
+                "sharded saves run synchronously (collective write)",
+                stacklevel=2,
+            )
         self.base_rng = jax.random.key(config.seed)
 
         # --- abstract state + shardings, then materialise on-mesh ---------
@@ -238,7 +296,8 @@ class GPTTrainer:
 
         # --- compiled steps ----------------------------------------------
         self._train_step = jax.jit(
-            make_train_step(gpt_config, self.optimizer, self.mesh),
+            make_train_step(gpt_config, self.optimizer, self.mesh,
+                            grad_accum=config.grad_accum_steps),
             in_shardings=(self.shardings, (self.batch_sharding,) * 2, self.repl),
             out_shardings=(self.shardings, self.repl),
             donate_argnums=(0,),
@@ -368,7 +427,23 @@ class GPTTrainer:
                 self.save_snapshot(epoch_done)
             if stop:
                 break
+        self._join_pending_save()  # async_save: flush before returning
         return last
+
+    def _join_pending_save(self) -> None:
+        """Wait for an in-flight async snapshot write; re-raise its failure
+        (a swallowed write error would mean silently resuming from a stale
+        checkpoint after the next restart)."""
+        t = getattr(self, "_save_thread", None)
+        if t is not None:
+            t.join()
+            self._save_thread = None
+        exc = getattr(self, "_save_exc", None)
+        if exc is not None:
+            self._save_exc = None
+            raise RuntimeError(
+                f"async snapshot write to {self.snapshot_path} failed"
+            ) from exc
 
     def evaluate(self) -> float:
         assert self.test_iter is not None
@@ -428,10 +503,41 @@ class GPTTrainer:
                 opt_state = self.state["opt_state"]
             if not self.is_writer:
                 return
-            ckpt_lib.save_snapshot(
-                self.snapshot_path,
-                ckpt_lib.Snapshot(params=params, opt_state=opt_state, **common),
-            )
+            if self.config.async_save:
+                # overlap serialization + IO (the slow part for object
+                # stores) with training. The host copy happens HERE, before
+                # the thread starts: the device buffers are donated to the
+                # next step and would be invalidated under the writer.
+                host_snap = ckpt_lib.Snapshot(
+                    params=jax.device_get(params),
+                    opt_state=jax.device_get(opt_state),
+                    **common,
+                )
+                self._join_pending_save()  # re-raises a prior failed write
+                import threading
+
+                path, step = self.snapshot_path, self.step
+
+                def _write():
+                    try:
+                        ckpt_lib.save_snapshot(path, host_snap)
+                        print(
+                            f"Snapshot saved to {path} "
+                            f"(epoch {epoch}, step {step}, msgpack, async)"
+                        )
+                    except BaseException as e:  # re-raised at next join
+                        self._save_exc = e
+
+                self._save_thread = threading.Thread(target=_write)
+                self._save_thread.start()
+                return
+            else:
+                ckpt_lib.save_snapshot(
+                    self.snapshot_path,
+                    ckpt_lib.Snapshot(
+                        params=params, opt_state=opt_state, **common
+                    ),
+                )
         if self.is_writer:
             print(
                 f"Snapshot saved to {self.snapshot_path} "
