@@ -116,15 +116,26 @@ def inner() -> int:
     from mingpt_distributed_tpu.training.optimizer import make_optimizer
     from mingpt_distributed_tpu.training.trainer import make_train_step
 
-    seq = 1024
+    # env overrides exist so the end-to-end bench contract (one JSON line,
+    # metric/value/unit/vs_baseline keys) is testable on CPU with a tiny
+    # model; the driver's real run uses the defaults
+    model = os.environ.get("BENCH_MODEL", "gpt2")
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    default_batches = tuple(
+        int(b) for b in os.environ.get("BENCH_BATCHES", "32,16,8,4").split(",")
+    )
 
-    def bench_attention(attention: str) -> tuple[int, float] | None:
+    def bench_attention(
+        attention: str, batches=default_batches, scan_unroll: int = 1
+    ) -> tuple[int, float] | None:
         """(batch, steps/sec) at the largest batch that fits, else None."""
         cfg = GPTConfig.make(
-            model_type="gpt2",
+            model_type=model,
             embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
             dtype="bfloat16",
             attention=attention,
+            scan_unroll=scan_unroll,
+            block_size=max(seq, 1024),
         )
         optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
         step_fn = jax.jit(make_train_step(cfg, optimizer), donate_argnums=(0,))
@@ -165,7 +176,7 @@ def inner() -> int:
 
         # retry smaller on ANY failure: HBM OOM can surface as an opaque
         # compile error depending on the backend, not just RESOURCE_EXHAUSTED
-        for batch in (32, 16, 8, 4):
+        for batch in batches:
             try:
                 return batch, try_batch(batch)
             except Exception as e:  # noqa: BLE001
@@ -176,18 +187,31 @@ def inner() -> int:
         return None
 
     results: dict[str, tuple[int, float]] = {}
+    unrolls: dict[str, int] = {}
     for attention in ("flash", "einsum"):
         r = bench_attention(attention)
         if r is not None:
             results[attention] = r
+            unrolls[attention] = 1
             print(f"{attention}: batch={r[0]} steps/sec={r[1]:.3f}",
+                  file=sys.stderr)
+
+    if "flash" in results:
+        # one bounded extra compile: layer-scan unroll at the winning batch
+        # (lets XLA fuse across layer boundaries); keep it if faster
+        b_star, sps_star = results["flash"]
+        r = bench_attention("flash", batches=(b_star,), scan_unroll=4)
+        if r is not None and r[1] > sps_star:
+            results["flash"] = r
+            unrolls["flash"] = 4
+            print(f"flash unroll=4: steps/sec={r[1]:.3f} (kept)",
                   file=sys.stderr)
 
     if not results:
         print(json.dumps(_error_record("all attention paths failed or OOMed")))
         return 0
 
-    cfg = GPTConfig.make(model_type="gpt2")
+    cfg = GPTConfig.make(model_type=model)
     fpt = flops_per_token(cfg, seq)
     peak = peak_flops_per_chip()
 
@@ -202,6 +226,7 @@ def inner() -> int:
             "batch": batch,
             "tokens_per_sec_per_chip": round(tps, 1),
             "mfu": round(mfu, 4) if mfu is not None else None,
+            "scan_unroll": unrolls.get(attention, 1),
         }
 
     best = max(
@@ -220,6 +245,7 @@ def inner() -> int:
         # number exists, so the baseline is the target
         "vs_baseline": round(mfu / 0.80, 4) if mfu is not None else None,
         "attention": best,
+        "scan_unroll": unrolls.get(best, 1),
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "flops_per_token": fpt,
         "achieved_tflops": round(tokens_per_sec * fpt / 1e12, 2),

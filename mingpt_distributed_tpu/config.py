@@ -142,6 +142,10 @@ class GPTConfig:
     # never materialises whole. 0/1 = dense (reference semantics; identical
     # loss either way). Ignored when T is not divisible by it.
     loss_chunks: int = 8
+    # lax.scan unroll factor for the layer loop (>= 1; lax.scan handles a
+    # non-dividing remainder): >1 lets XLA fuse across layer boundaries at
+    # the cost of compile time.
+    scan_unroll: int = 1
 
     @classmethod
     def make(cls, **kwargs: Any) -> "GPTConfig":
@@ -196,6 +200,10 @@ class GPTConfig:
             )
         if self.attention not in ("einsum", "flash", "ring", "ulysses"):
             raise ConfigError(f"unknown attention impl {self.attention!r}")
+        if self.scan_unroll < 1:
+            raise ConfigError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.loss_chunks < 0:
+            raise ConfigError(f"loss_chunks must be >= 0, got {self.loss_chunks}")
         if self.rope and (self.n_embd // self.n_head) % 2 != 0:
             raise ConfigError(
                 f"rope needs an even head_dim, got {self.n_embd // self.n_head}"

@@ -368,7 +368,8 @@ def forward(
                     return run(carry, blk, key), None
             step_pp = jax.checkpoint(body_pp) if cfg.remat else body_pp
             (y, aux), _ = jax.lax.scan(
-                step_pp, (x_mb, jnp.zeros((), jnp.float32)), xs_local
+                step_pp, (x_mb, jnp.zeros((), jnp.float32)), xs_local,
+                unroll=cfg.scan_unroll,
             )
             return y, aux
 
@@ -381,7 +382,8 @@ def forward(
         )
     else:
         (x, moe_aux), _ = jax.lax.scan(
-            step, (x, jnp.zeros((), jnp.float32)), xs
+            step, (x, jnp.zeros((), jnp.float32)), xs,
+            unroll=cfg.scan_unroll,
         )
 
     x = _norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)

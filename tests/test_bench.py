@@ -1,0 +1,33 @@
+"""The driver contract: `python bench.py` prints ONE parseable JSON line
+with metric/value/unit/vs_baseline keys — exercised end-to-end (probe
+subprocess, bounded measurement subprocess, JSON emission) with a tiny
+model on the CPU backend via the BENCH_* env overrides."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_emits_one_json_line():
+    env = dict(os.environ)
+    env.update(
+        PYTHONPATH="", PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        BENCH_MODEL="gpt-nano", BENCH_SEQ="32", BENCH_BATCHES="4",
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [l for l in proc.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, proc.stdout
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec, rec
+    # on CPU there is no error path hit and throughput was measured
+    assert "error" not in rec, rec
+    assert rec["paths"], rec
+    assert rec["tokens_per_sec_per_chip"] > 0, rec
