@@ -17,6 +17,15 @@ amortise. The whole schedule is one ``lax.scan`` inside one ``shard_map``,
 so it is reverse-differentiable as-is: autodiff transposes ppermute into the
 reverse hop and the backward pass runs the mirror-image pipeline.
 
+Why GPipe (+ remat) and not 1F1B: 1F1B's advantage over GPipe is live
+activation memory — O(pp) in-flight microbatches instead of O(M) — at the
+cost of hand-orchestrating interleaved forward/backward (a custom_vjp over
+the whole schedule; autodiff can no longer derive the backward pipeline).
+Under XLA the same memory bound comes from ``cfg.remat``: per-tick
+activations are rematerialised in the transposed scan, so stored state is
+one activation per microbatch boundary, while the schedule stays a plain
+differentiable scan the compiler can fuse. Same bubble fraction either way.
+
 Composition:
 - pp x dp/fsdp: batch stays sharded over BATCH_AXES inside the region.
 - pp x sp (``seq_sharded=True``): activations stay sequence-sharded inside
