@@ -18,6 +18,7 @@ import sys
 def main() -> int:
     snapshot_path = sys.argv[1]
     max_steps = int(sys.argv[2])
+    mesh_kind = sys.argv[3] if len(sys.argv) > 3 else "dp2"
 
     import jax
 
@@ -49,11 +50,19 @@ def main() -> int:
         block_size=16, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
         dtype="float32",
     )
+    # "dp2": 2 procs x 1 device, pure data parallel (the reference's shape).
+    # "hybrid": 2 procs x 4 devices — dp crosses the process (DCN) boundary
+    # while fsdp/tp ride the intra-process (ICI) axes, the scaling-book
+    # hybrid-mesh recipe; exercises cross-host param gathers + tp collectives.
+    mesh_cfg = {
+        "dp2": MeshConfig(dp=2, fsdp=1, tp=1, sp=1),
+        "hybrid": MeshConfig(dp=2, fsdp=2, tp=2, sp=1),
+    }[mesh_kind]
     tcfg = TrainerConfig.make(
         max_epochs=1, batch_size=8, grad_norm_clip=1.0, save_every=100,
         log_every=1000, seed=7, max_steps=max_steps,
         snapshot_path=snapshot_path,
-        mesh=MeshConfig(dp=2, fsdp=1, tp=1, sp=1),
+        mesh=mesh_cfg,
         prefetch=0,
     )
     tr = GPTTrainer(tcfg, gcfg, OptimizerConfig(learning_rate=1e-2), train, test)

@@ -26,7 +26,8 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_pair(snapshot: str, max_steps: int, timeout=600):
+def _run_pair(snapshot: str, max_steps: int, timeout=600, mesh="dp2",
+              local_devices=1):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -35,26 +36,38 @@ def _run_pair(snapshot: str, max_steps: int, timeout=600):
             PYTHONPATH=str(REPO),  # repo importable; TPU-plugin sitecustomize stripped
             PALLAS_AXON_POOL_IPS="",
             JAX_PLATFORMS="cpu",
-            XLA_FLAGS="",  # one local device per process
+            XLA_FLAGS=(
+                f"--xla_force_host_platform_device_count={local_devices}"
+                if local_devices > 1 else ""
+            ),
             COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
             NUM_PROCESSES="2",
             PROCESS_ID=str(pid),
         )
         procs.append(subprocess.Popen(
-            [sys.executable, "tests/multihost_worker.py", snapshot, str(max_steps)],
+            [sys.executable, "tests/multihost_worker.py", snapshot,
+             str(max_steps), mesh],
             cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         ))
     results = {}
     logs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        logs.append(out)
-        assert p.returncode == 0, f"worker failed:\n{out}"
-        for line in out.splitlines():
-            if line.startswith("MULTIHOST_RESULT "):
-                r = json.loads(line[len("MULTIHOST_RESULT "):])
-                results[r["process"]] = r
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            logs.append(out)
+            assert p.returncode == 0, f"worker failed:\n{out}"
+            for line in out.splitlines():
+                if line.startswith("MULTIHOST_RESULT "):
+                    r = json.loads(line[len("MULTIHOST_RESULT "):])
+                    results[r["process"]] = r
+    finally:
+        # a failed/deadlocked worker must not leak past the test: the peer
+        # blocks forever in a collective holding the coordinator socket
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
     assert set(results) == {0, 1}, f"missing results:\n{''.join(logs)}"
     return results, logs
 
@@ -79,4 +92,20 @@ def test_two_process_training_and_resume(tmp_path):
     saved_notices = [
         ("Snapshot saved" in log) for log in logs2
     ]
+    assert sum(saved_notices) == 1
+
+
+@pytest.mark.slow
+def test_hybrid_mesh_two_hosts(tmp_path):
+    """2 processes x 4 local devices: dp crosses the process (DCN) boundary,
+    fsdp/tp ride the intra-process axes — cross-host param gathers, tp
+    collectives and the snapshot process_allgather all on one mesh."""
+    snap = str(tmp_path / "mh_hybrid.msgpack")
+    results, logs = _run_pair(snap, max_steps=3, mesh="hybrid",
+                              local_devices=4)
+    assert results[0]["end_step"] == 3 and results[1]["end_step"] == 3
+    # the eval loss is a global mean — identical on every host
+    assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-6
+    assert os.path.exists(snap)
+    saved_notices = [("Snapshot saved" in log) for log in logs]
     assert sum(saved_notices) == 1
