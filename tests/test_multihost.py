@@ -54,7 +54,21 @@ def _run_pair(snapshot: str, max_steps: int, timeout=600, mesh="dp2",
     logs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=timeout)
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                # kill BOTH, drain their output, and surface it — a bare
+                # TimeoutExpired with no worker logs is undiagnosable
+                drained = []
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                    o, _ = q.communicate()
+                    drained.append(o or "")
+                raise AssertionError(
+                    "worker deadlock/timeout; captured logs:\n"
+                    + "\n=== next worker ===\n".join(drained)
+                ) from None
             logs.append(out)
             assert p.returncode == 0, f"worker failed:\n{out}"
             for line in out.splitlines():

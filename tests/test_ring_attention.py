@@ -130,3 +130,21 @@ def test_ring_flash_inner_gradients(eight_devices):
     for a, b in zip(g_ring, g_want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("t,h,kv,hd,sp,dp", [
+    (64, 4, 4, 16, 8, 1),
+    (64, 4, 2, 16, 4, 2),
+    (128, 8, 8, 8, 4, 2),
+    (96, 2, 1, 32, 2, 4),   # c=48 -> flash inner, block 48
+    (40, 2, 2, 16, 2, 4),   # c=20 -> not tileable -> einsum inner fallback
+])
+def test_ring_differential_sweep(eight_devices, t, h, kv, hd, sp, dp):
+    """Ring == dense oracle across chunk sizes that route to the flash
+    inner (tileable) and the einsum inner (non-tileable) alike."""
+    mesh = sp_mesh(dp=dp, sp=sp)
+    q, k, v = qkv(b=max(2, dp), t=t, h=h, kv=kv, hd=hd, seed=t + h)
+    want = attn_ops.causal_attention(q, k, v)
+    got = jax.jit(lambda *a: ring_causal_attention(*a, mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
