@@ -133,13 +133,25 @@ def _forward_cached(
 
 def _select_next(
     logits: jax.Array, rng, temperature: float, do_sample: bool,
-    top_k: Optional[int],
+    top_k: Optional[int], top_p: Optional[float] = None,
 ) -> jax.Array:
-    """Temperature / top-k / sample-vs-argmax — reference model.py:341-352."""
+    """Temperature / top-k / sample-vs-argmax — reference model.py:341-352 —
+    plus nucleus (top-p) filtering as a beyond-parity extension."""
     logits = logits / jnp.maximum(temperature, 1e-8)
     if top_k is not None:
         k = min(top_k, logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None and top_p < 1.0:
+        desc = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens whose preceding cumulative mass is < top_p (the top
+        # token always survives); threshold at the smallest kept logit
+        keep = (cum - probs) < top_p
+        kth = jnp.min(
+            jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
+        )
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if do_sample:
         return jax.random.categorical(rng, logits, axis=-1)
@@ -148,11 +160,13 @@ def _select_next(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "do_sample", "top_k"),
+    static_argnames=("cfg", "max_new_tokens", "temperature", "do_sample",
+                     "top_k", "top_p"),
 )
 def _generate_jit(
     params, idx, rng, *, cfg: GPTConfig, max_new_tokens: int,
     temperature: float, do_sample: bool, top_k: Optional[int],
+    top_p: Optional[float] = None,
 ):
     b, t0 = idx.shape
     cache = init_cache(cfg, b)
@@ -160,14 +174,16 @@ def _generate_jit(
 
     # prefill the prompt, pick the first new token
     logits, cache = _forward_cached(params, idx, cache, 0, cfg)
-    first = _select_next(logits, step_keys[0], temperature, do_sample, top_k)
+    first = _select_next(logits, step_keys[0], temperature, do_sample,
+                         top_k, top_p)
     if max_new_tokens == 1:  # static
         return jnp.concatenate([idx, first[:, None]], axis=1)
 
     def step(carry, step_rng):
         tok, cache, pos = carry
         logits, cache = _forward_cached(params, tok[:, None], cache, pos, cfg)
-        nxt = _select_next(logits, step_rng, temperature, do_sample, top_k)
+        nxt = _select_next(logits, step_rng, temperature, do_sample,
+                           top_k, top_p)
         return (nxt, cache, pos + 1), tok
 
     (last, _, _), toks = jax.lax.scan(
@@ -181,11 +197,13 @@ def _generate_jit(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "max_new_tokens", "temperature", "do_sample", "top_k"),
+    static_argnames=("cfg", "max_new_tokens", "temperature", "do_sample",
+                     "top_k", "top_p"),
 )
 def _generate_sliding_jit(
     params, idx, rng, *, cfg: GPTConfig, max_new_tokens: int,
     temperature: float, do_sample: bool, top_k: Optional[int],
+    top_p: Optional[float] = None,
 ):
     """Reference-semantics sliding-window decode (model.py:336-337): every
     step forwards the last ``block_size`` tokens with positions 0..len-1.
@@ -205,7 +223,7 @@ def _generate_sliding_jit(
             logits_all, length - 1, 1, axis=1
         )[:, 0]
         nxt = _select_next(
-            logits, step_rng, temperature, do_sample, top_k
+            logits, step_rng, temperature, do_sample, top_k, top_p
         ).astype(jnp.int32)
         full = length >= bs
         base = jnp.where(full, jnp.roll(window, -1, axis=1), window)
