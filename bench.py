@@ -31,7 +31,37 @@ import time
 
 METRIC = "mfu_gpt2_124m_seq1024"
 PROBE_TIMEOUT_S = 240
+
+
+def _env_num(name: str, default, cast):
+    """Env override that can never break the one-JSON-line contract: a
+    malformed value falls back to the default instead of raising."""
+    try:
+        val = cast(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+    return val if val >= 0 else default
+
+
+# VERDICT r2: a single 240 s probe converted a flaky-but-recoverable tunnel
+# into a null round artifact.  Retry with backoff, bounded at ~28 min worst
+# case (6 x 240 s timeouts + 5 x 45 s backoffs).
+PROBE_ATTEMPTS = _env_num("BENCH_PROBE_ATTEMPTS", 6, int)
+PROBE_BACKOFF_S = _env_num("BENCH_PROBE_BACKOFF_S", 45.0, float)
 BENCH_TIMEOUT_S = 2400
+
+# Error signatures worth retrying: tunnel/backend reachability flaps. A
+# permanent failure (ImportError, bad venv) answers in ~1 s and must fail
+# fast rather than burn the full retry budget on an unwinnable probe.
+_TRANSIENT_MARKERS = (
+    "timed out", "unavailable", "deadline", "connection", "connect",
+    "socket", "unreachable", "reset", "refused", "no json",
+)
+
+
+def _is_transient(msg: str) -> bool:
+    low = msg.lower()
+    return any(m in low for m in _TRANSIENT_MARKERS)
 
 
 def _error_record(msg: str) -> dict:
@@ -70,8 +100,56 @@ def _probe_backend() -> dict:
         return {"error": "backend probe produced no JSON"}
 
 
+def _probe_backend_with_retry() -> dict:
+    """Retry the bounded probe: the TPU tunnel here is documented to flap
+    for stretches (BASELINE.md round 2 — down ~4 h at end-of-round bench
+    time), and a transient outage must not turn into a null round record
+    when one more attempt a minute later would have answered."""
+    last: dict = {"error": "no probe attempts made"}
+    for attempt in range(1, PROBE_ATTEMPTS + 1):
+        last = _probe_backend()
+        if "error" not in last:
+            return last
+        print(
+            f"probe attempt {attempt}/{PROBE_ATTEMPTS}: {last['error']}",
+            file=sys.stderr,
+        )
+        if not _is_transient(last["error"]):
+            return last  # permanent: retrying can't fix an ImportError
+        if attempt < PROBE_ATTEMPTS:
+            time.sleep(PROBE_BACKOFF_S)
+    return last
+
+
+def check_throughput_plausible(
+    tokens_per_sec: float,
+    flops_per_token: float,
+    peak_flops: float | None,
+    slack: float = 1.2,
+) -> None:
+    """Honesty guard for the D2H timing workaround (VERDICT r2 weak #5).
+
+    Timing here synchronizes via a real device_get of the last chained
+    step's loss because ``block_until_ready`` returns early on this remote
+    backend.  If the backend quirk ever extends to ``device_get`` too, the
+    measured wall-clock collapses and the reported throughput becomes
+    physically impossible.  Refuse to report a number that implies more
+    than ``slack``× the chip's peak FLOP rate — fail loudly instead.
+    """
+    if peak_flops is None or not tokens_per_sec:
+        return
+    achieved = tokens_per_sec * flops_per_token
+    if achieved > slack * peak_flops:
+        raise RuntimeError(
+            f"implausible throughput: {achieved / 1e12:.1f} TFLOP/s implied "
+            f"> {slack}x chip peak {peak_flops / 1e12:.1f} TFLOP/s — the "
+            "D2H sync is not actually synchronizing on this backend; "
+            "refusing to report inflated numbers"
+        )
+
+
 def main() -> int:
-    probe = _probe_backend()
+    probe = _probe_backend_with_retry()
     if "error" in probe:
         print(json.dumps(_error_record(probe["error"])))
         return 0
@@ -247,6 +325,11 @@ def inner() -> int:
     )
     batch, sps = results[best]
     tokens_per_sec, mfu = mfu_of(batch, sps)
+    try:
+        check_throughput_plausible(tokens_per_sec, fpt, peak)
+    except RuntimeError as e:
+        print(json.dumps(_error_record(str(e))))
+        return 0
 
     def emit(long_ctx):
         dev = jax.devices()[0]

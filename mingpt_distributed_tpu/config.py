@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import dataclasses
 import io
+import warnings
 from dataclasses import dataclass, field
 from typing import Any, Mapping, Optional, Sequence, Tuple
 
@@ -346,6 +347,15 @@ class TrainerConfig:
             cfg.mesh = mesh if isinstance(mesh, MeshConfig) else MeshConfig.make(**mesh)
         if isinstance(cfg.profile_steps, list):
             cfg.profile_steps = tuple(cfg.profile_steps)
+        if cfg.learning_rate is not None:
+            warnings.warn(
+                "TrainerConfig.learning_rate is accepted for schema parity "
+                "with the reference (trainer.py:21-29) but IGNORED — the "
+                "optimizer owns the learning rate; set "
+                "optimizer_config.learning_rate instead.",
+                UserWarning,
+                stacklevel=2,
+            )
         return cfg
 
 

@@ -68,12 +68,15 @@ class GPT:
         do_sample: bool = False,
         top_k: Optional[int] = None,
         *,
+        top_p: Optional[float] = None,
         rng: Optional[jax.Array] = None,
     ) -> jax.Array:
-        """Reference signature (model.py:323-328), KV-cached compiled decode."""
+        """Reference signature (model.py:323-328), KV-cached compiled decode;
+        keyword-only ``top_p`` (nucleus sampling) is a beyond-parity extra."""
         return _generate.generate(
             self.params, self.config, idx, max_new_tokens,
-            temperature=temperature, do_sample=do_sample, top_k=top_k, rng=rng,
+            temperature=temperature, do_sample=do_sample, top_k=top_k,
+            top_p=top_p, rng=rng,
         )
 
     @classmethod

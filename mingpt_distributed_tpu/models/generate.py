@@ -146,9 +146,12 @@ def _select_next(
         desc = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(desc, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        # keep tokens whose preceding cumulative mass is < top_p (the top
-        # token always survives); threshold at the smallest kept logit
+        # keep tokens whose preceding cumulative mass is < top_p; the top
+        # token must survive unconditionally (top_p <= 0 would otherwise
+        # mask every token and degenerate to token id 0), making top_p→0
+        # equivalent to greedy; threshold at the smallest kept logit
         keep = (cum - probs) < top_p
+        keep = keep.at[..., 0].set(True)
         kth = jnp.min(
             jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True
         )
@@ -245,13 +248,15 @@ def generate(
     temperature: float = 1.0,
     do_sample: bool = False,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``idx`` (B, T0).
 
     Keeps the reference's signature and semantics (model.py:323-328),
     including unbounded generation past the context window; one compiled
-    program per (prompt_len, max_new_tokens) pair thereafter.
+    program per (prompt_len, max_new_tokens) pair thereafter. ``top_p``
+    (nucleus sampling) is a beyond-parity extension.
     """
     idx = jnp.asarray(idx, dtype=jnp.int32)
     if idx.ndim == 1:
@@ -266,6 +271,7 @@ def generate(
             params, idx, rng, cfg=cfg, max_new_tokens=max_new_tokens,
             temperature=float(temperature), do_sample=bool(do_sample),
             top_k=None if top_k is None else int(top_k),
+            top_p=None if top_p is None else float(top_p),
         )
     # overflow: reference-exact sliding window over the last block_size
     # tokens; the full prompt still heads the returned sequence
@@ -274,5 +280,6 @@ def generate(
         max_new_tokens=max_new_tokens, temperature=float(temperature),
         do_sample=bool(do_sample),
         top_k=None if top_k is None else int(top_k),
+        top_p=None if top_p is None else float(top_p),
     )
     return jnp.concatenate([idx, new], axis=1)

@@ -28,6 +28,9 @@ def main(argv=None) -> int:
     p.add_argument("--max-new-tokens", type=int, default=200)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None,
+                   help="nucleus sampling: keep the smallest set of tokens "
+                        "with cumulative probability >= top_p")
     p.add_argument("--greedy", action="store_true",
                    help="argmax decoding (default: sample)")
     p.add_argument("--seed", type=int, default=0)
@@ -79,6 +82,7 @@ def main(argv=None) -> int:
         temperature=args.temperature,
         do_sample=not args.greedy,
         top_k=args.top_k,
+        top_p=args.top_p,
         rng=jax.random.key(args.seed),
     )
     print(dataset.decode(jax.device_get(out)[0]))

@@ -3,12 +3,23 @@ with metric/value/unit/vs_baseline keys — exercised end-to-end (probe
 subprocess, bounded measurement subprocess, JSON emission) with a tiny
 model on the CPU backend via the BENCH_* env overrides."""
 
+import importlib.util
 import json
 import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def test_bench_emits_one_json_line():
@@ -31,3 +42,59 @@ def test_bench_emits_one_json_line():
     assert "error" not in rec, rec
     assert rec["paths"], rec
     assert rec["tokens_per_sec_per_chip"] > 0, rec
+
+
+def test_throughput_honesty_check_rejects_impossible_numbers():
+    """VERDICT r2 weak #5: if device_get ever returns early like
+    block_until_ready does on this backend, the implied TFLOP rate exceeds
+    chip peak and the bench must fail loudly, not report it."""
+    bench = _load_bench()
+    peak = 197e12
+    fpt = 1e9  # ~GPT-2-ish flops/token at seq 1024
+    # plausible: 0.35 MFU worth of throughput passes
+    bench.check_throughput_plausible(0.35 * peak / fpt, fpt, peak)
+    # exactly at slack boundary passes; beyond it raises
+    with pytest.raises(RuntimeError, match="implausible throughput"):
+        bench.check_throughput_plausible(5.0 * peak / fpt, fpt, peak)
+    # unknown chip (no peak table entry) can't be checked — no raise
+    bench.check_throughput_plausible(1e12, fpt, None)
+
+
+def test_probe_retries_with_backoff(monkeypatch):
+    """VERDICT r2 missing #3: one transient probe failure must not produce
+    a null round record — the probe retries until an attempt succeeds."""
+    bench = _load_bench()
+    calls = []
+
+    def fake_probe():
+        calls.append(1)
+        if len(calls) < 3:
+            return {"error": "backend probe timed out after 240s"}
+        return {"platform": "tpu", "kind": "TPU v5 lite", "n": 1}
+
+    monkeypatch.setattr(bench, "_probe_backend", fake_probe)
+    monkeypatch.setattr(bench, "PROBE_BACKOFF_S", 0.0)
+    out = bench._probe_backend_with_retry()
+    assert out == {"platform": "tpu", "kind": "TPU v5 lite", "n": 1}
+    assert len(calls) == 3
+
+    # all attempts failing transiently returns the last error after
+    # PROBE_ATTEMPTS tries
+    calls.clear()
+    err = {"error": "backend UNAVAILABLE: tunnel reset"}
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda: (calls.append(1) or dict(err))
+    )
+    out = bench._probe_backend_with_retry()
+    assert out == err
+    assert len(calls) == bench.PROBE_ATTEMPTS
+
+    # a permanent failure (broken env) fails fast: exactly one attempt
+    calls.clear()
+    perm = {"error": "backend probe failed: ModuleNotFoundError: jax"}
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda: (calls.append(1) or dict(perm))
+    )
+    out = bench._probe_backend_with_retry()
+    assert out == perm
+    assert len(calls) == 1
