@@ -148,3 +148,39 @@ def test_ring_differential_sweep(eight_devices, t, h, kv, hd, sp, dp):
     got = jax.jit(lambda *a: ring_causal_attention(*a, mesh))(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_zigzag_ring_kernel_work_is_exact_causal_share(eight_devices, monkeypatch):
+    """VERDICT r2 next #3: the zigzag ring must spend exactly the causal
+    triangle's FLOPs per device — T^2/(2n) kernel work — instead of the
+    contiguous ring's ~T^2/n (full non-causal kernels on fully-masked
+    future chunks, folded with weight zero). Counted at trace time: the
+    shard_map body traces once (SPMD), so the counts are per-device."""
+    from mingpt_distributed_tpu.ops import flash_attention as fa
+
+    sp, t, hd = 4, 512, 16
+    calls = []
+    real = fa.flash_with_lse
+
+    def counting(q, k, v, scale, block, causal=True):
+        # work units: batch * q_len * k_len, causal diagonal counts half
+        calls.append(q.shape[0] * q.shape[1] * k.shape[1] * (0.5 if causal else 1.0))
+        return real(q, k, v, scale, block, causal)
+
+    monkeypatch.setattr(fa, "flash_with_lse", counting)
+    mesh = sp_mesh(dp=1, sp=sp)
+    q, k, v = qkv(b=1, t=t, h=2, hd=hd, seed=13)
+    got = jax.jit(lambda *a: ring_causal_attention(*a, mesh))(q, k, v)
+
+    bh = 1 * 2
+    # trace-time structure: 3 step-0 calls + ONE traced scan body (lax.scan
+    # traces its hop once; it executes sp-1 times)
+    assert len(calls) == 4, calls
+    per_device_work = sum(calls[:3]) + (sp - 1) * calls[3]
+    ideal = bh * t * t / (2 * sp)  # causal triangle share of one device
+    assert per_device_work == ideal, (per_device_work, ideal, calls)
+
+    # correctness unchanged by the placement
+    want = attn_ops.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
