@@ -148,11 +148,83 @@ def check_throughput_plausible(
         )
 
 
+def profile_inner(outdir: str) -> int:
+    """Capture a jax.profiler device trace of the winning train-step config
+    (VERDICT r2 next #2): 3 warmup steps, then 5 traced steps. Analyse with
+    TensorBoard's profile plugin / Perfetto on the written xplane files."""
+    import jax
+    import jax.numpy as jnp
+
+    from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.training.optimizer import make_optimizer
+    from mingpt_distributed_tpu.training.trainer import make_train_step
+
+    model = os.environ.get("BENCH_MODEL", "gpt2")
+    seq = int(os.environ.get("BENCH_SEQ", "1024"))
+    batch = int(os.environ.get("BENCH_PROFILE_BATCH", "16"))
+    attention = os.environ.get("BENCH_PROFILE_ATTENTION", "flash")
+    cfg = GPTConfig.make(
+        model_type=model,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="bfloat16", attention=attention,
+        block_size=max(seq, 1024),
+    )
+    optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
+    step_fn = jax.jit(make_train_step(cfg, optimizer), donate_argnums=(0,))
+    state = jax.jit(
+        lambda k: {
+            "params": gpt.init(k, cfg),
+            "opt_state": optimizer.init(gpt.init(k, cfg)),
+            "step": jnp.asarray(0, dtype=jnp.int32),
+        }
+    )(jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, seq), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    rng = jax.random.key(2)
+    for _ in range(3):
+        state, m = step_fn(state, (tokens, tokens), rng)
+    float(jax.device_get(m["loss"]))
+    n = 5
+    with jax.profiler.trace(outdir):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state, m = step_fn(state, (tokens, tokens), rng)
+        loss = float(jax.device_get(m["loss"]))
+        dt = time.perf_counter() - t0
+    print(json.dumps({
+        "profile_dir": outdir, "batch": batch, "seq": seq,
+        "attention": attention, "steps": n,
+        "steps_per_sec": round(n / dt, 3), "loss": loss,
+        "device": jax.devices()[0].device_kind,
+    }))
+    return 0
+
+
 def main() -> int:
     probe = _probe_backend_with_retry()
     if "error" in probe:
         print(json.dumps(_error_record(probe["error"])))
         return 0
+    if "--profile" in sys.argv:
+        i = sys.argv.index("--profile")
+        outdir = (
+            sys.argv[i + 1]
+            if len(sys.argv) > i + 1 and not sys.argv[i + 1].startswith("-")
+            else "profile_trace"
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--profile-inner", outdir],
+                timeout=BENCH_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps(_error_record(
+                f"profile run timed out after {BENCH_TIMEOUT_S}s")))
+            return 0
+        return proc.returncode
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--inner"],
@@ -408,4 +480,6 @@ def inner() -> int:
 if __name__ == "__main__":
     if "--inner" in sys.argv:
         sys.exit(inner())
+    if "--profile-inner" in sys.argv:
+        sys.exit(profile_inner(sys.argv[sys.argv.index("--profile-inner") + 1]))
     sys.exit(main())

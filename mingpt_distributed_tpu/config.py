@@ -118,6 +118,13 @@ class GPTConfig:
     # microbatch per stage. Bubble fraction is (pp-1)/(M+pp-1), so raise M
     # for efficiency, bounded by batch divisibility and activation memory.
     pp_microbatches: int = 0
+    # Pipeline schedule: "gpipe" (plain differentiable scan; autodiff derives
+    # the backward pipeline; live activations O(M) microbatches) or "1f1b"
+    # (custom-vjp backward that interleaves recompute-forward with backward
+    # in 1F1B order, bounding the backward's stage-input stash to O(pp)
+    # microbatches at the cost of one extra forward per stage-microbatch
+    # vs gpipe+remat — pick it when activation HBM, not FLOPs, binds).
+    pp_schedule: str = "gpipe"
     # Tie the LM head to the token embedding (GPT-2 ties; the reference's
     # head is an independent bias-free Linear, model.py:249 — keep that as
     # the default for parity).
@@ -203,6 +210,11 @@ class GPTConfig:
             raise ConfigError(f"unknown attention impl {self.attention!r}")
         if self.scan_unroll < 1:
             raise ConfigError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ConfigError(
+                f"unknown pp_schedule {self.pp_schedule!r} "
+                "(choose 'gpipe' or '1f1b')"
+            )
         if self.loss_chunks < 0:
             raise ConfigError(f"loss_chunks must be >= 0, got {self.loss_chunks}")
         if self.rope and (self.n_embd // self.n_head) % 2 != 0:

@@ -17,14 +17,25 @@ amortise. The whole schedule is one ``lax.scan`` inside one ``shard_map``,
 so it is reverse-differentiable as-is: autodiff transposes ppermute into the
 reverse hop and the backward pass runs the mirror-image pipeline.
 
-Why GPipe (+ remat) and not 1F1B: 1F1B's advantage over GPipe is live
-activation memory — O(pp) in-flight microbatches instead of O(M) — at the
-cost of hand-orchestrating interleaved forward/backward (a custom_vjp over
-the whole schedule; autodiff can no longer derive the backward pipeline).
-Under XLA the same memory bound comes from ``cfg.remat``: per-tick
-activations are rematerialised in the transposed scan, so stored state is
-one activation per microbatch boundary, while the schedule stays a plain
-differentiable scan the compiler can fuse. Same bubble fraction either way.
+Two schedules (``schedule=`` / cfg.pp_schedule):
+
+* **"gpipe"** (default): the whole schedule is one plain differentiable
+  scan — autodiff transposes ppermute into the reverse hop and derives the
+  backward pipeline; combined with ``cfg.remat`` the stored state per tick
+  is small, but the scan's saved carries still grow with the microbatch
+  count M.
+* **"1f1b"**: identical forward; the backward is a hand-written custom-vjp
+  that re-runs the forward pipeline and interleaves each stage's transposed
+  (backward) application with the recompute in classic 1F1B order — stage s
+  transposes microbatch m exactly 2(pp-1-s) ticks after re-stashing its
+  input, so the live stage-input stash is a ring buffer of 2(pp-1)+1
+  microbatches: O(pp), independent of M. Compute cost is one extra forward
+  vs GPipe+remat — 3 forwards + 1 backward per stage-microbatch (primal,
+  the stash-rebuilding recompute, and the vjp's own linearization forward;
+  the two bwd-tick forwards run on different microbatches so they cannot
+  fuse). Choose it when M is large enough that GPipe's O(M) per-tick
+  stashes dominate HBM and the ~25% step-FLOP tax is worth the headroom.
+  Same bubble fraction either way.
 
 Composition:
 - pp x dp/fsdp: batch stays sharded over BATCH_AXES inside the region.
@@ -37,8 +48,11 @@ Composition:
   batch-ish axes average, reproducing the single-device aux semantics.
   (Expert weights are gathered at stage entry like the rest of the stage's
   params — ZeRO-style JIT gather — so combine pp with ep=1.)
-- Layer-granular tensor parallelism inside a stage is not composed here
-  (entering the manual region gathers each stage's params over fsdp/tp).
+- pp x tp/fsdp (``xs_specs``): the caller may pass per-leaf PartitionSpecs
+  for ``xs`` so stage parameters STAY tp/fsdp-sharded inside the manual
+  region instead of being gathered at entry; ``apply_stack`` then owns the
+  megatron math (models/gpt.py: per-shard heads/ffn columns, one psum over
+  ``tp`` per residual branch, per-layer all_gather over ``fsdp``).
 """
 
 from __future__ import annotations
@@ -47,9 +61,142 @@ from typing import Any, Callable, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
+
+
+def _split_diff(tree):
+    """Flatten a pytree and mark which leaves are differentiable (inexact
+    dtype). PRNG-key and integer leaves (e.g. per-layer dropout keys riding
+    the scanned xs) get float0 cotangents from the custom vjp."""
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    mask = [jnp.issubdtype(jnp.asarray(l).dtype, jnp.inexact) for l in flat]
+    return flat, treedef, mask
+
+
+def _rebuild(flat, treedef, mask, diff_vals):
+    it = iter(diff_vals)
+    return jax.tree_util.tree_unflatten(
+        treedef, [next(it) if k else orig for orig, k in zip(flat, mask)]
+    )
+
+
+def _float0_cotangents(flat, treedef, mask, diff_cts):
+    from jax import dtypes as jdtypes
+
+    it = iter(diff_cts)
+    out = [
+        next(it) if k else np.zeros(np.shape(orig), jdtypes.float0)
+        for orig, k in zip(flat, mask)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _make_1f1b(tick_scan, apply_stack, pp: int, m: int):
+    """Wrap the GPipe forward in a custom vjp whose backward runs the 1F1B
+    interleave: one combined scan where every tick does (a) one forward
+    recompute tick, stashing the stage's input in a ring buffer, and (b) one
+    transposed (backward) application 2(pp-1-stage) ticks behind, consuming
+    the stash and rotating the cotangent one hop backwards. Stage pp-1 has
+    lag 0 — its backward starts the very tick its forward recompute runs —
+    which is what bounds the stash at 2(pp-1)+1 in-flight microbatches."""
+    lag = pp - 1
+    stash_n = 2 * lag + 1
+    fwd_shift = [(i, (i + 1) % pp) for i in range(pp)]
+    rev_shift = [(i, (i - 1) % pp) for i in range(pp)]
+
+    @jax.custom_vjp
+    def run(mbs, xs, consts):
+        return tick_scan(mbs, xs, consts)
+
+    def fwd_rule(mbs, xs, consts):
+        return tick_scan(mbs, xs, consts), (mbs, xs, consts)
+
+    def bwd_rule(res, cts):
+        mbs, xs, consts = res
+        g_outs, g_aux = cts
+        act_dtype = mbs.dtype
+        xs_flat, xs_tree, xs_mask = _split_diff(xs)
+        c_flat, c_tree, c_mask = _split_diff(consts)
+        diff_xs = tuple(l for l, k in zip(xs_flat, xs_mask) if k)
+        diff_c = tuple(l for l, k in zip(c_flat, c_mask) if k)
+
+        def tick(carry, t):
+            fstate, bstate, stash, g_mbs, g_xs, g_c = carry
+            stage = jax.lax.axis_index("pp")
+
+            # -- forward recompute (GPipe order), stashing stage INPUTS ----
+            inp = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, m - 1), 0, keepdims=False
+            )
+            fstate = jnp.where(stage == 0, inp, fstate)
+            mb_f = jnp.clip(t - stage, 0, m - 1).astype(jnp.int32)
+            fvalid = (t >= stage) & (t - stage < m)
+            slot_f = mb_f % stash_n
+            old = jax.lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+            stash = jax.lax.dynamic_update_index_in_dim(
+                stash, jnp.where(fvalid, fstate, old), slot_f, 0
+            )
+            fstate, _ = apply_stack(fstate, xs, consts, mb_f)
+            fstate = jax.lax.ppermute(fstate, "pp", fwd_shift)
+
+            # -- backward: transpose stage apply for mb (t - 2*lag + stage) -
+            mb_b = t - 2 * lag + stage
+            bvalid = (mb_b >= 0) & (mb_b < m)
+            mb_bc = jnp.clip(mb_b, 0, m - 1).astype(jnp.int32)
+            x_in = jax.lax.dynamic_index_in_dim(
+                stash, mb_bc % stash_n, 0, keepdims=False
+            )
+            g_in = jnp.where(
+                stage == lag,
+                jax.lax.dynamic_index_in_dim(g_outs, mb_bc, 0, keepdims=False)
+                .astype(act_dtype),
+                bstate,
+            )
+            g_y = jnp.where(bvalid, g_in, jnp.zeros_like(g_in))
+            g_a = jnp.where(bvalid, g_aux, 0.0).astype(jnp.float32)
+
+            def apply_d(x, dxs, dc):
+                return apply_stack(
+                    x,
+                    _rebuild(xs_flat, xs_tree, xs_mask, dxs),
+                    _rebuild(c_flat, c_tree, c_mask, dc),
+                    mb_bc,
+                )
+
+            _, vjp_fn = jax.vjp(apply_d, x_in, diff_xs, diff_c)
+            gx, g_dxs, g_dc = vjp_fn((g_y, g_a))
+            gx = gx.astype(act_dtype)
+            g_xs = jax.tree.map(jnp.add, g_xs, tuple(g_dxs))
+            g_c = jax.tree.map(jnp.add, g_c, tuple(g_dc))
+            gm_old = jax.lax.dynamic_index_in_dim(g_mbs, mb_bc, 0, keepdims=False)
+            g_mbs = jax.lax.dynamic_update_index_in_dim(
+                g_mbs, jnp.where((stage == 0) & bvalid, gx, gm_old), mb_bc, 0
+            )
+            bstate = jax.lax.ppermute(gx, "pp", rev_shift)
+            return (fstate, bstate, stash, g_mbs, g_xs, g_c), None
+
+        init = (
+            jnp.zeros_like(mbs[0]),                                  # fstate
+            jnp.zeros_like(mbs[0]),                                  # bstate
+            jnp.zeros((stash_n, *mbs.shape[1:]), act_dtype),         # stash
+            jnp.zeros_like(mbs),                                     # g_mbs
+            tuple(jnp.zeros_like(l) for l in diff_xs),
+            tuple(jnp.zeros_like(l) for l in diff_c),
+        )
+        (_, _, _, g_mbs, g_dxs, g_dc), _ = jax.lax.scan(
+            tick, init, jnp.arange(m + 2 * lag)
+        )
+        return (
+            g_mbs,
+            _float0_cotangents(xs_flat, xs_tree, xs_mask, g_dxs),
+            _float0_cotangents(c_flat, c_tree, c_mask, g_dc),
+        )
+
+    run.defvjp(fwd_rule, bwd_rule)
+    return run
 
 
 def pipeline_blocks(
@@ -61,6 +208,8 @@ def pipeline_blocks(
     *,
     n_microbatches: int = 0,
     seq_sharded: bool = False,
+    xs_specs: Any = None,
+    schedule: str = "gpipe",
 ) -> Tuple[jax.Array, jax.Array]:
     """Apply all layers to ``x`` across pipeline stages.
 
@@ -71,6 +220,10 @@ def pipeline_blocks(
     stochastic ops like dropout decorrelate across microbatches).
     ``seq_sharded`` keeps the sequence dim sharded over ``sp`` inside the
     region (apply_stack must then run sequence-parallel attention).
+    ``xs_specs`` (a PartitionSpec pytree matching ``xs``) keeps stage params
+    sharded over further axes (tp/fsdp) inside the region — apply_stack must
+    then run the matching per-shard math; default gathers everything but the
+    ``pp`` layer axis at entry.
     Returns (activations, aux) — semantically equivalent to scanning the
     full layer axis on one device.
     """
@@ -89,38 +242,50 @@ def pipeline_blocks(
                 f"local batch {b} not divisible by {m} microbatches "
                 f"(global batch / (dp*fsdp) must divide pp_microbatches)"
             )
-        stage = jax.lax.axis_index("pp")
         mbs = x_local.reshape(m, b // m, *x_local.shape[1:])
-        state = jnp.zeros_like(mbs[0])
-        outs = jnp.zeros_like(mbs)
         shift = [(i, (i + 1) % pp) for i in range(pp)]
 
-        def tick(carry, t):
-            state, outs, aux_tot = carry
-            inp = jax.lax.dynamic_index_in_dim(
-                mbs, jnp.clip(t, 0, m - 1), 0, keepdims=False
-            )
-            state = jnp.where(stage == 0, inp, state)
-            # the microbatch this stage holds at tick t entered at t - stage
-            mb_idx = jnp.clip(t - stage, 0, m - 1).astype(jnp.int32)
-            state, aux = apply_stack(state, xs_local, consts_, mb_idx)
-            # warm-up/drain ticks process zero-padding, not data — mask
-            # their aux out (outputs are filtered by the banking below)
-            valid = (t >= stage) & (t - stage < m)
-            aux_tot = aux_tot + jnp.where(valid, aux, 0.0)
-            # bank stage pp-1's finished microbatch (index t - pp + 1)
-            oidx = jnp.maximum(t - (pp - 1), 0)
-            prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
-            bank = jnp.where((stage == pp - 1) & (t >= pp - 1), state, prev)
-            outs = jax.lax.dynamic_update_index_in_dim(outs, bank, oidx, 0)
-            state = jax.lax.ppermute(state, "pp", shift)
-            return (state, outs, aux_tot), None
+        def tick_scan(mbs_, xs_, consts_in):
+            """GPipe forward ticks -> (outs, aux_tot); outs are banked on
+            the last stage only (zeros elsewhere; broadcast happens below)."""
 
-        (_, outs, aux_tot), _ = jax.lax.scan(
-            tick,
-            (state, outs, jnp.zeros((), jnp.float32)),
-            jnp.arange(m + pp - 1),
-        )
+            def tick(carry, t):
+                state, outs, aux_tot = carry
+                stage = jax.lax.axis_index("pp")
+                inp = jax.lax.dynamic_index_in_dim(
+                    mbs_, jnp.clip(t, 0, m - 1), 0, keepdims=False
+                )
+                state = jnp.where(stage == 0, inp, state)
+                # the microbatch this stage holds at tick t entered at t - stage
+                mb_idx = jnp.clip(t - stage, 0, m - 1).astype(jnp.int32)
+                state, aux = apply_stack(state, xs_, consts_in, mb_idx)
+                # warm-up/drain ticks process zero-padding, not data — mask
+                # their aux out (outputs are filtered by the banking below)
+                valid = (t >= stage) & (t - stage < m)
+                aux_tot = aux_tot + jnp.where(valid, aux, 0.0)
+                # bank stage pp-1's finished microbatch (index t - pp + 1)
+                oidx = jnp.maximum(t - (pp - 1), 0)
+                prev = jax.lax.dynamic_index_in_dim(outs, oidx, 0, keepdims=False)
+                bank = jnp.where((stage == pp - 1) & (t >= pp - 1), state, prev)
+                outs = jax.lax.dynamic_update_index_in_dim(outs, bank, oidx, 0)
+                state = jax.lax.ppermute(state, "pp", shift)
+                return (state, outs, aux_tot), None
+
+            (_, outs, aux_tot), _ = jax.lax.scan(
+                tick,
+                (jnp.zeros_like(mbs_[0]), jnp.zeros_like(mbs_),
+                 jnp.zeros((), jnp.float32)),
+                jnp.arange(m + pp - 1),
+            )
+            return outs, aux_tot
+
+        if schedule == "1f1b":
+            outs, aux_tot = _make_1f1b(tick_scan, apply_stack, pp, m)(
+                mbs, xs_local, consts_
+            )
+        else:
+            outs, aux_tot = tick_scan(mbs, xs_local, consts_)
+        stage = jax.lax.axis_index("pp")
         # results live on the last stage; broadcast so every stage returns
         # the full activations (head/loss then run replicated over pp)
         outs = jax.lax.psum(
@@ -138,7 +303,7 @@ def pipeline_blocks(
     fn = jax.shard_map(
         shard_fn,
         mesh=mesh,
-        in_specs=(x_spec, P("pp"), P()),
+        in_specs=(x_spec, xs_specs if xs_specs is not None else P("pp"), P()),
         out_specs=(x_spec, P()),
         check_vma=False,
     )

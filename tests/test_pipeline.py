@@ -222,3 +222,210 @@ def test_pp_with_ep_refused(eight_devices):
     )
     with pytest.raises(NotImplementedError, match="ep"):
         gpt.forward(params, tokens, cfg, targets=tokens, mesh=mesh)
+
+
+def test_pp_tp_forward_matches_dense(eight_devices):
+    """pp=2 x tp=2 x dp=2: megatron-tp runs INSIDE the pipeline stages
+    (per-shard heads/ffn columns, one psum per residual branch) — logits
+    and loss must match the dense single-device forward."""
+    cfg, params, tokens = cfg_and_inputs()
+    want_logits, want_loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=2, sp=1), devices=eight_devices
+    )
+    got_logits, got_loss = jax.jit(
+        lambda p, t: gpt.forward(p, t, cfg, targets=t, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
+
+
+def test_pp_tp_gradients_match_dense(eight_devices):
+    cfg, params, tokens = cfg_and_inputs()
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=2, sp=1), devices=eight_devices
+    )
+    g_want = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens)[1]
+    )(params)
+    g_got = jax.jit(jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens, mesh=mesh)[1]
+    ))(params)
+    flat_want = jax.tree_util.tree_leaves_with_path(g_want)
+    for (path, want), got in zip(flat_want, jax.tree.leaves(g_got)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-4,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_pp_tp_swiglu_llama_mode(eight_devices):
+    """tp inside pp with the llama toggles (SwiGLU row/column split, RoPE,
+    GQA kv_heads split over tp)."""
+    cfg, params, tokens = cfg_and_inputs(
+        rope=True, swiglu=True, rmsnorm=True, tie_weights=True
+    )
+    want_logits, want_loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=2, sp=1), devices=eight_devices
+    )
+    got_logits, got_loss = jax.jit(
+        lambda p, t: gpt.forward(p, t, cfg, targets=t, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
+
+
+def test_pp_tp_fsdp_params_stay_sharded_inside_region(
+    eight_devices, monkeypatch
+):
+    """VERDICT r2 next #5's memory assertion: inside the pipeline's manual
+    region, tp must still be SPLIT on the weights _block actually computes
+    with (not gathered at entry), and fsdp must be gathered per-layer at
+    point of use. Shapes are recorded at trace time inside the region."""
+    cfg, params, tokens = cfg_and_inputs()  # n_head=2, d=32 -> nhd=32
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=1, fsdp=2, tp=2, sp=1), devices=eight_devices
+    )
+    seen = {}
+    real_block = gpt._block
+
+    def recording_block(x, blk, *a, **kw):
+        seen["wq"] = blk["wq"].shape
+        seen["w_fc"] = blk["w_fc"].shape
+        seen["wo"] = blk["wo"].shape
+        return real_block(x, blk, *a, **kw)
+
+    monkeypatch.setattr(gpt, "_block", recording_block)
+    _, loss = jax.jit(
+        lambda p, t: gpt.forward(p, t, cfg, targets=t, mesh=mesh)
+    )(params, tokens)
+
+    d, nhd, ffn = 32, 32, 128
+    # tp LIVE inside the region: output columns halved on column-parallel
+    # weights, input rows halved on row-parallel weights...
+    assert seen["wq"] == (d, nhd // 2), seen
+    assert seen["w_fc"] == (d, ffn // 2), seen
+    assert seen["wo"] == (nhd // 2, d), seen
+    # ...and the fsdp factor is GONE at point of use (per-layer JIT gather
+    # restored the full d rows: sharded at rest, whole only while computing)
+    assert np.isfinite(float(loss))
+
+
+def test_pp_tp_trainer_matches_dp(tmp_path, eight_devices):
+    """Full jitted train step: pp=2 x tp=2 x dp=2 must reproduce the
+    pure-DP loss trajectory."""
+    from tests.test_trainer import losses_for
+
+    l_dp = losses_for(tmp_path, MeshConfig(dp=-1), name="pt_a")
+    l_pptp = losses_for(
+        tmp_path, MeshConfig(pp=2, dp=2, fsdp=1, tp=2), name="pt_b"
+    )
+    np.testing.assert_allclose(l_dp, l_pptp, rtol=2e-4, atol=2e-4)
+
+
+def test_1f1b_forward_matches_dense(eight_devices):
+    """pp_schedule=1f1b: forward is the same GPipe scan — logits and loss
+    must match the dense single-device forward exactly like gpipe does."""
+    cfg, params, tokens = cfg_and_inputs(pp_schedule="1f1b", pp_microbatches=4)
+    want_logits, want_loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    mesh = pp_mesh(eight_devices, pp=4, dp=2)
+    got_logits, got_loss = jax.jit(
+        lambda p, t: gpt.forward(p, t, cfg, targets=t, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
+
+
+def test_1f1b_gradients_match_dense(eight_devices):
+    """The hand-written 1F1B backward (recompute + interleaved transpose +
+    O(pp) ring stash) must produce the same gradients as autodiff through
+    the dense scan — for every parameter leaf."""
+    cfg, params, tokens = cfg_and_inputs(pp_schedule="1f1b", pp_microbatches=4)
+    mesh = pp_mesh(eight_devices, pp=4, dp=2)
+    g_want = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens)[1]
+    )(params)
+    g_got = jax.jit(jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens, mesh=mesh)[1]
+    ))(params)
+    flat_want = jax.tree_util.tree_leaves_with_path(g_want)
+    for (path, want), got in zip(flat_want, jax.tree.leaves(g_got)):
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-4,
+            err_msg=f"1f1b grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_1f1b_with_tp_gradients(eight_devices):
+    """1f1b composes with megatron-tp inside the stages."""
+    cfg, params, tokens = cfg_and_inputs(pp_schedule="1f1b")
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=2, sp=1), devices=eight_devices
+    )
+    g_want = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens)[1]
+    )(params)
+    g_got = jax.jit(jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens, mesh=mesh)[1]
+    ))(params)
+    for a, b in zip(jax.tree.leaves(g_got), jax.tree.leaves(g_want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_1f1b_matches_gpipe_with_dropout(eight_devices):
+    """Same rng => identical loss under both schedules (the 1f1b custom vjp
+    must carry the non-differentiable per-layer PRNG keys through its
+    residuals and give them float0 cotangents)."""
+    mesh = pp_mesh(eight_devices, pp=2, dp=1)
+    tokens = jnp.tile(jnp.arange(16, dtype=jnp.int32)[None], (8, 1))
+
+    losses = {}
+    grads = {}
+    for sched in ("gpipe", "1f1b"):
+        cfg, params, _ = cfg_and_inputs(
+            n_layer=2, resid_pdrop=0.3, pp_microbatches=2, pp_schedule=sched
+        )
+
+        def loss_fn(p):
+            return gpt.forward(
+                p, tokens, cfg, targets=tokens, rng=jax.random.key(5),
+                deterministic=False, mesh=mesh,
+            )[1]
+
+        losses[sched] = float(jax.jit(loss_fn)(params))
+        grads[sched] = jax.jit(jax.grad(loss_fn))(params)
+
+    np.testing.assert_allclose(losses["gpipe"], losses["1f1b"], rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(grads["gpipe"]),
+                    jax.tree.leaves(grads["1f1b"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_1f1b_with_moe_aux_gradients(eight_devices):
+    """The aux (load-balancing) loss cotangent flows through the 1f1b
+    backward: grads must match the dense run including the aux term."""
+    cfg, params, tokens = cfg_and_inputs(
+        n_experts=2, moe_top_k=1, moe_capacity_factor=4.0,
+        pp_schedule="1f1b",
+    )
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=4, fsdp=1, tp=1, sp=1), devices=eight_devices
+    )
+    g_want = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens)[1]
+    )(params)
+    g_got = jax.jit(jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg, targets=tokens, mesh=mesh)[1]
+    ))(params)
+    for a, b in zip(jax.tree.leaves(g_got), jax.tree.leaves(g_want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
