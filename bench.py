@@ -218,13 +218,25 @@ def main() -> int:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__),
                  "--profile-inner", outdir],
+                capture_output=True,
+                text=True,
                 timeout=BENCH_TIMEOUT_S,
             )
         except subprocess.TimeoutExpired:
             print(json.dumps(_error_record(
                 f"profile run timed out after {BENCH_TIMEOUT_S}s")))
             return 0
-        return proc.returncode
+        sys.stderr.write(proc.stderr)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                print(json.dumps(json.loads(line)))
+                return 0
+            except ValueError:
+                continue
+        tail = (proc.stderr or "").strip().splitlines()[-3:]
+        print(json.dumps(_error_record(
+            f"profile rc={proc.returncode}, no JSON: " + " | ".join(tail))))
+        return 0
     try:
         proc = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--inner"],
@@ -288,7 +300,8 @@ def inner() -> int:
     )
 
     def bench_attention(
-        attention: str, batches=default_batches, scan_unroll: int = 1
+        attention: str, batches=default_batches, scan_unroll: int = 1,
+        remat: bool = False,
     ) -> tuple[int, float] | None:
         """(batch, steps/sec) at the largest batch that fits, else None."""
         cfg = GPTConfig.make(
@@ -297,6 +310,7 @@ def inner() -> int:
             dtype="bfloat16",
             attention=attention,
             scan_unroll=scan_unroll,
+            remat=remat,
             block_size=max(seq, 1024),
         )
         optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
@@ -350,24 +364,55 @@ def inner() -> int:
 
     results: dict[str, tuple[int, float]] = {}
     unrolls: dict[str, int] = {}
+    remats: dict[str, bool] = {}
     for attention in ("flash", "einsum"):
         r = bench_attention(attention)
+        remats[attention] = False
+        if r is None:
+            # every batch failed (HBM): trade FLOPs for memory and retry —
+            # a remat-ed number beats a null record
+            r = bench_attention(attention, remat=True)
+            remats[attention] = True
         if r is not None:
             results[attention] = r
             unrolls[attention] = 1
-            print(f"{attention}: batch={r[0]} steps/sec={r[1]:.3f}",
-                  file=sys.stderr)
+            print(
+                f"{attention}: batch={r[0]} steps/sec={r[1]:.3f}"
+                + (" (remat)" if remats[attention] else ""),
+                file=sys.stderr,
+            )
 
+    flash_block = None  # None = the kernel's default ladder choice
     if "flash" in results:
         # one bounded extra compile: layer-scan unroll at the winning batch
         # (lets XLA fuse across layer boundaries); keep it if faster
         b_star, sps_star = results["flash"]
-        r = bench_attention("flash", batches=(b_star,), scan_unroll=4)
+        r = bench_attention("flash", batches=(b_star,), scan_unroll=4,
+                            remat=remats["flash"])
         if r is not None and r[1] > sps_star:
             results["flash"] = r
             unrolls["flash"] = 4
             print(f"flash unroll=4: steps/sec={r[1]:.3f} (kept)",
                   file=sys.stderr)
+        # flash block-size sweep at the winning batch (VERDICT r2 weak #4:
+        # the (512, 256, 128) ladder was never measured) — two bounded
+        # extra compiles; keep the override only if it beats the default
+        for blk in (256, 128):
+            os.environ["FLASH_BLOCK"] = str(blk)
+            try:
+                r = bench_attention(
+                    "flash", batches=(results["flash"][0],),
+                    scan_unroll=unrolls["flash"], remat=remats["flash"],
+                )
+            finally:
+                os.environ.pop("FLASH_BLOCK", None)
+            if r is not None and r[1] > results["flash"][1]:
+                results["flash"] = r
+                flash_block = blk
+                print(f"flash block={blk}: steps/sec={r[1]:.3f} (kept)",
+                      file=sys.stderr)
+        if flash_block is not None:
+            os.environ["FLASH_BLOCK"] = str(flash_block)  # for extras below
 
     if not results:
         print(json.dumps(_error_record("all attention paths failed or OOMed")))
@@ -389,6 +434,7 @@ def inner() -> int:
             "tokens_per_sec_per_chip": round(tps, 1),
             "mfu": round(mfu, 4) if mfu is not None else None,
             "scan_unroll": unrolls.get(attention, 1),
+            "remat": remats.get(attention, False),
         }
 
     best = max(
@@ -414,6 +460,7 @@ def inner() -> int:
             "vs_baseline": round(mfu / 0.80, 4) if mfu is not None else None,
             "attention": best,
             "scan_unroll": unrolls.get(best, 1),
+            "flash_block": flash_block,  # None = default ladder
             "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
             "flops_per_token": fpt,
             "achieved_tflops": round(tokens_per_sec * fpt / 1e12, 2),

@@ -446,18 +446,24 @@ class GPTTrainer:
             ) from exc
 
     def evaluate(self) -> float:
+        """Mean loss over the eval set.
+
+        Losses stay on device; the loop only *blocks* on the step two
+        iterations back (the train loop's two-in-flight cap) instead of
+        fetching every batch — on a pod a per-batch device_get costs a full
+        host round-trip per batch and stalls the dispatch pipeline
+        (VERDICT r2 weak #7). Values are fetched once at the end.
+        """
         assert self.test_iter is not None
         losses = []
         self.test_iter.state = IteratorState(seed=self.config.seed)
         for i, xy in enumerate(self.test_iter.epoch_batches()):
             if self.config.eval_batches and i >= self.config.eval_batches:
                 break
-            # fetch each eval loss: keeps the dispatch queue depth bounded
-            # (eval isn't throughput-critical; see the train-loop note)
-            losses.append(float(jax.device_get(
-                self._eval_step(self.state, self._put_batch(xy))
-            )))
-        return float(np.mean(losses))
+            losses.append(self._eval_step(self.state, self._put_batch(xy)))
+            if len(losses) >= 2:
+                jax.block_until_ready(losses[-2])
+        return float(np.mean([float(v) for v in jax.device_get(losses)]))
 
     def save_snapshot(self, epoch: int) -> None:
         """Single-writer (global process 0 — the B9 fix) snapshot.

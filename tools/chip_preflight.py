@@ -118,13 +118,29 @@ def main() -> int:
                                  "error": str(e)[:200]})
         all_ok = False
 
+    on_chip = dev.platform == "tpu"
     record["pass"] = all_ok
-    out = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "PREFLIGHT.json")
-    with open(out, "w") as f:
-        json.dump(record, f, indent=1)
-    print(json.dumps({"preflight": "PASS" if all_ok else "FAIL",
-                      "n_checks": len(record["checks"])}))
+    record["on_chip"] = on_chip
+    # The artifact records ON-CHIP compiled-kernel parity. An interpret-mode
+    # run (CPU fallback — e.g. the TPU plugin failed to init) must neither
+    # overwrite a real on-chip record nor report success, or the exact
+    # silent-regression class this tool closes reopens. CPU smoke runs of
+    # the script itself set PREFLIGHT_ALLOW_CPU=1.
+    allow_cpu = os.environ.get("PREFLIGHT_ALLOW_CPU") == "1"
+    if on_chip:
+        out = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "PREFLIGHT.json")
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+    print(json.dumps({
+        "preflight": "PASS" if all_ok else "FAIL",
+        "on_chip": on_chip,
+        "n_checks": len(record["checks"]),
+    }))
+    if not on_chip and not allow_cpu:
+        print("not on TPU hardware (interpret mode) — refusing PASS; "
+              "set PREFLIGHT_ALLOW_CPU=1 for a CPU smoke run", file=sys.stderr)
+        return 1
     return 0 if all_ok else 1
 
 
