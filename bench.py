@@ -222,7 +222,19 @@ def main() -> int:
                 text=True,
                 timeout=BENCH_TIMEOUT_S,
             )
-        except subprocess.TimeoutExpired:
+        except subprocess.TimeoutExpired as e:
+            # salvage a completed record from partial stdout (same recovery
+            # as the inner-mode handler): the trace may hang AFTER the
+            # measurement line was printed
+            partial = e.stdout or b""
+            if isinstance(partial, bytes):
+                partial = partial.decode(errors="replace")
+            for line in reversed(partial.strip().splitlines()):
+                try:
+                    print(json.dumps(json.loads(line)))
+                    return 0
+                except ValueError:
+                    continue
             print(json.dumps(_error_record(
                 f"profile run timed out after {BENCH_TIMEOUT_S}s")))
             return 0

@@ -26,8 +26,10 @@
 // Build: make (g++ -std=c++17 pjrt_smoke.cc -ldl). Run: ./pjrt_smoke [plugin.so]
 
 #include <dlfcn.h>
+#include <unistd.h>
 
 #include <cstdint>
+#include <ctime>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -267,11 +269,76 @@ int main(int argc, char** argv) {
   }
 
   // ---- stage 2: client + device inventory -------------------------------
+  // The axon relay plugin (this environment's tunnel to the real chip)
+  // requires session/topology create options that its Python shim normally
+  // supplies (/root/.axon_site/axon/register/pjrt.py:161-210). Mirror them
+  // here so the C++ smoke test can bring the client up standalone: topology
+  // "<gen>:1x1x1", remote_compile (terminal-side compilation — this image
+  // has no local libtpu), the monoclient rank sentinel 0xFFFFFFFF, and a
+  // fresh session_id keying the terminal's session lock. A plain libtpu
+  // plugin ignores/needs none of these, so they are only attached when the
+  // plugin path names axon (or PJRT_SMOKE_AXON=1 forces it).
+  // Also required in the ENVIRONMENT for the relay (normally set by the
+  // shim's sitecustomize): AXON_POOL_SVC_OVERRIDE=127.0.0.1 and
+  // AXON_LOOPBACK_RELAY=1 — without them client create fails fast asking
+  // for an orchestrator URL.
+  std::vector<PJRT_NamedValue> create_opts;
+  std::vector<std::string> opt_storage;  // keeps option strings alive
+  // string_value pointers below alias opt_storage elements: reallocation
+  // would move SSO strings and dangle them, so reserve the exact capacity
+  opt_storage.reserve(8);
+  const bool axon_plugin =
+      strstr(so_path, "axon") != nullptr ||
+      (getenv("PJRT_SMOKE_AXON") != nullptr &&
+       strcmp(getenv("PJRT_SMOKE_AXON"), "1") == 0);
+  if (axon_plugin) {
+    auto add_str = [&](const char* name, std::string value) {
+      opt_storage.push_back(std::move(value));
+      PJRT_NamedValue v;
+      memset(&v, 0, sizeof(v));
+      v.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      v.name = name;
+      v.name_size = strlen(name);
+      v.type = PJRT_NamedValue_kString;
+      v.string_value = opt_storage.back().c_str();
+      v.value_size = opt_storage.back().size();
+      create_opts.push_back(v);
+    };
+    auto add_int = [&](const char* name, int64_t value) {
+      PJRT_NamedValue v;
+      memset(&v, 0, sizeof(v));
+      v.struct_size = PJRT_NamedValue_STRUCT_SIZE;
+      v.name = name;
+      v.name_size = strlen(name);
+      v.type = PJRT_NamedValue_kInt64;
+      v.int64_value = value;
+      v.value_size = 1;
+      create_opts.push_back(v);
+    };
+    const char* gen = getenv("PALLAS_AXON_TPU_GEN");
+    std::string topology = std::string(gen ? gen : "v5e") + ":1x1x1";
+    const char* rc = getenv("PALLAS_AXON_REMOTE_COMPILE");
+    char session[64];
+    snprintf(session, sizeof(session), "pjrt-smoke-%d-%ld",
+             static_cast<int>(getpid()),
+             static_cast<long>(time(nullptr)));
+    add_int("remote_compile", (rc == nullptr || strcmp(rc, "1") == 0) ? 1 : 0);
+    add_int("local_only", 0);
+    add_int("priority", 0);
+    add_str("topology", topology);
+    add_int("n_slices", 1);
+    add_str("session_id", session);
+    add_int("rank", 0xFFFFFFFFll);  // monoclient sentinel
+    printf("INFO: axon create options: topology=%s session_id=%s\n",
+           topology.c_str(), session);
+  }
   PJRT_Client* client = nullptr;
   {
     PJRT_Client_Create_Args args;
     memset(&args, 0, sizeof(args));
     args.struct_size = PJRT_Client_Create_Args_STRUCT_SIZE;
+    args.create_options = create_opts.empty() ? nullptr : create_opts.data();
+    args.num_options = create_opts.size();
     CHECK_OK(g_api->PJRT_Client_Create(&args), "PJRT_Client_Create");
     client = args.client;
   }
