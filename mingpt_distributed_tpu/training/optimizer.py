@@ -90,15 +90,22 @@ def lr_schedule(cfg: OptimizerConfig) -> Callable[[Any], Any]:
 
 
 def make_optimizer(
-    cfg: OptimizerConfig, grad_norm_clip: Optional[float] = None
+    cfg: OptimizerConfig,
+    grad_norm_clip: Optional[float] = None,
+    schedule: Optional[Callable[[Any], Any]] = None,
 ) -> optax.GradientTransformation:
-    """clip -> scale_by_adam -> masked weight decay -> lr, as one chain."""
+    """clip -> scale_by_adam -> masked weight decay -> lr, as one chain.
+
+    ``schedule`` lets the caller share ONE schedule object between the
+    optimizer and metrics reporting, so the logged lr is the applied lr by
+    construction (defaults to ``lr_schedule(cfg)``).
+    """
     parts = []
     if grad_norm_clip is not None and grad_norm_clip > 0:
         parts.append(optax.clip_by_global_norm(grad_norm_clip))
     parts += [
         optax.scale_by_adam(b1=cfg.betas[0], b2=cfg.betas[1], eps=cfg.eps),
         optax.add_decayed_weights(cfg.weight_decay, mask=decay_mask),
-        optax.scale_by_learning_rate(lr_schedule(cfg)),
+        optax.scale_by_learning_rate(schedule or lr_schedule(cfg)),
     ]
     return optax.chain(*parts)

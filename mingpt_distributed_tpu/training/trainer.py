@@ -50,7 +50,7 @@ from mingpt_distributed_tpu.models import gpt
 from mingpt_distributed_tpu.parallel import mesh as mesh_lib
 from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
 from mingpt_distributed_tpu.training.metrics import MetricsLogger
-from mingpt_distributed_tpu.training.optimizer import make_optimizer
+from mingpt_distributed_tpu.training.optimizer import lr_schedule, make_optimizer
 
 TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
 
@@ -63,6 +63,7 @@ def make_train_step(
     optimizer: optax.GradientTransformation,
     mesh=None,
     grad_accum: int = 1,
+    lr_fn=None,  # step -> learning rate, for the metrics line (SURVEY §5.5)
 ):
     """forward+backward+update as one pure function of (state, batch, rng).
 
@@ -136,6 +137,8 @@ def make_train_step(
         )
         new_params = optax.apply_updates(state["params"], updates)
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if lr_fn is not None:
+            metrics["lr"] = lr_fn(state["step"])
         return (
             {"params": new_params, "opt_state": new_opt, "step": state["step"] + 1},
             metrics,
@@ -197,7 +200,12 @@ class GPTTrainer:
                 f"{dict(self.mesh.shape)})"
             )
 
-        self.optimizer = make_optimizer(optimizer_config, config.grad_norm_clip)
+        # ONE schedule object feeds both the optax chain and the metrics
+        # line, so the logged lr is the applied lr by construction
+        self._lr_fn = lr_schedule(optimizer_config)
+        self.optimizer = make_optimizer(
+            optimizer_config, config.grad_norm_clip, schedule=self._lr_fn
+        )
         self.train_iter = ShardedBatchIterator(
             train_dataset,
             config.batch_size,
@@ -297,7 +305,8 @@ class GPTTrainer:
         # --- compiled steps ----------------------------------------------
         self._train_step = jax.jit(
             make_train_step(gpt_config, self.optimizer, self.mesh,
-                            grad_accum=config.grad_accum_steps),
+                            grad_accum=config.grad_accum_steps,
+                            lr_fn=self._lr_fn),
             in_shardings=(self.shardings, (self.batch_sharding,) * 2, self.repl),
             out_shardings=(self.shardings, self.repl),
             donate_argnums=(0,),

@@ -52,3 +52,33 @@ def test_tensorboard_sink(tmp_path):
     log.log_step(2, 512, 16, {"loss": 2.5})
     log.close()
     assert glob.glob(str(tmp_path / "tb" / "events.out.tfevents.*"))
+
+
+def test_train_step_reports_lr():
+    """SURVEY §5.5 prescribes loss / grad-norm / LR per step; the lr_fn
+    threads the schedule's current value into the metrics dict."""
+    import jax
+    import jax.numpy as jnp
+
+    from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.training.optimizer import (
+        lr_schedule,
+        make_optimizer,
+    )
+    from mingpt_distributed_tpu.training.trainer import make_train_step
+
+    cfg = GPTConfig.make(
+        n_layer=1, n_head=2, n_embd=16, vocab_size=32, block_size=8,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    ocfg = OptimizerConfig(learning_rate=1e-3, warmup_steps=10)
+    opt = make_optimizer(ocfg, grad_norm_clip=1.0)
+    step_fn = jax.jit(make_train_step(cfg, opt, lr_fn=lr_schedule(ocfg)))
+    params = gpt.init(jax.random.key(0), cfg)
+    state = {"params": params, "opt_state": opt.init(params),
+             "step": jnp.asarray(4, jnp.int32)}
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    _, m = step_fn(state, (tokens, tokens), jax.random.key(1))
+    # linear warmup: step 4 of 10 -> 0.4 * peak
+    assert abs(float(m["lr"]) - 0.4e-3) < 1e-9
