@@ -308,7 +308,8 @@ def inner() -> int:
     model = os.environ.get("BENCH_MODEL", "gpt2")
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     default_batches = tuple(
-        int(b) for b in os.environ.get("BENCH_BATCHES", "32,16,8,4").split(",")
+        int(b)
+        for b in os.environ.get("BENCH_BATCHES", "64,32,16,8,4").split(",")
     )
 
     def bench_attention(
