@@ -55,6 +55,16 @@ MODEL_PRESETS: dict[str, dict[str, Any]] = {
                           rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
                           vocab_size=128256, block_size=8192, ffn_mult=3.5,
                           rope_theta=500000.0),  # Llama 3 base, not the 1e4 default
+    # Mistral-style presets: Llama architecture + sliding-window attention
+    # (each position attends the last `attention_window` tokens; the flash
+    # kernel skips out-of-band blocks so compute is O(T*window)).
+    "mistral-tiny":  dict(n_layer=4,  n_head=4,  n_embd=256,  n_kv_head=2,
+                          rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
+                          attention_window=64),
+    "mistral-7b":    dict(n_layer=32, n_head=32, n_embd=4096, n_kv_head=8,
+                          rope=True, swiglu=True, rmsnorm=True, tie_weights=False,
+                          vocab_size=32000, block_size=8192, ffn_mult=3.5,
+                          rope_theta=1000000.0, attention_window=4096),
     # Mixtral-style sparse MoE presets (SwiGLU experts, top-2 routing,
     # expert axis shards over the mesh's ep axis — ops/moe.py).
     "mixtral-tiny":  dict(n_layer=4,  n_head=4,  n_embd=256,  n_kv_head=2,
@@ -109,6 +119,12 @@ class GPTConfig:
     # "flash" (Pallas blockwise kernel), "ring" (sequence-parallel ring
     # attention over the mesh's `sp` axis).
     attention: str = "einsum"
+    # Sliding-window (banded) attention, Mistral-style: each position sees
+    # only the last `attention_window` tokens (itself included); None =
+    # full causal. Supported by the einsum oracle and the flash kernel
+    # (which skips out-of-band blocks: compute O(T*window), not O(T^2));
+    # not composed with ring/ulysses sequence parallelism.
+    attention_window: Optional[int] = None
     # Compute dtype for activations; params are kept in float32.
     dtype: str = "bfloat16"
     # Rematerialise each block in backward (jax.checkpoint) to trade FLOPs
@@ -208,6 +224,16 @@ class GPTConfig:
             )
         if self.attention not in ("einsum", "flash", "ring", "ulysses"):
             raise ConfigError(f"unknown attention impl {self.attention!r}")
+        if self.attention_window is not None:
+            if self.attention_window < 1:
+                raise ConfigError(
+                    f"attention_window must be >= 1, got {self.attention_window}"
+                )
+            if self.attention not in ("einsum", "flash"):
+                raise ConfigError(
+                    "attention_window (sliding-window attention) requires "
+                    f"attention='einsum' or 'flash', not {self.attention!r}"
+                )
         if self.scan_unroll < 1:
             raise ConfigError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
         if self.pp_schedule not in ("gpipe", "1f1b"):

@@ -78,7 +78,7 @@ def _cached_block(
     # positions correct, and the causal mask kills both future tokens and
     # never-written (zero) slots beyond offset+t
     att = attn_ops.causal_attention(
-        q, ck, cv, kv_offset=offset
+        q, ck, cv, kv_offset=offset, window=cfg.attention_window
     ).reshape(b, t, nh * hd)
     att = L.dense(att, blk["wo"], blk.get("bo"))
     x = x + att

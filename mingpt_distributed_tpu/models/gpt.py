@@ -229,11 +229,17 @@ def _block(
         cos, sin = rope
         q = attn_ops.apply_rope(q, cos, sin)
         k = attn_ops.apply_rope(k, cos, sin)
+    # window only reaches einsum/flash (config validation); the manual-sp
+    # attn_fn override never sees it
+    attn_kw = (
+        {"window": cfg.attention_window} if cfg.attention_window else {}
+    )
     att = (attn_fn or _attention_dispatch(cfg, mesh))(
         q, k, v,
         attn_pdrop=cfg.attn_pdrop,
         dropout_key=k_attn,
         deterministic=deterministic,
+        **attn_kw,
     ).reshape(b, t, nh * hd)
     if tp_axis is not None:
         att = jax.lax.psum(L.dense(att, blk["wo"]), tp_axis)

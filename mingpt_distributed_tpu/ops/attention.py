@@ -46,12 +46,16 @@ def causal_attention(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
     kv_offset: int | jax.Array = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Causal scaled-dot-product attention, softmax in float32.
 
     ``kv_offset`` is the absolute position of q[0] relative to k[0] — 0 for
     training (S == T, self-attention), the cache length during incremental
     decoding (so a single query attends to all cached keys).
+    ``window`` enables sliding-window (banded) attention: each query sees
+    only the last ``window`` positions, itself included (Mistral-style;
+    ``None`` = full causal).
     Returns (B, T, H, hd) in q's dtype.
     """
     b, t, h, hd = q.shape
@@ -69,6 +73,8 @@ def causal_attention(
     q_pos = jnp.arange(t)[:, None] + kv_offset  # absolute query positions
     k_pos = jnp.arange(s)[None, :]
     allowed = q_pos >= k_pos  # (T, S) boolean — the B6 fix
+    if window is not None:
+        allowed = allowed & (q_pos - k_pos < window)
     logits = jnp.where(allowed[None, None], logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)

@@ -90,8 +90,18 @@ def _block_sizes(t: int) -> Optional[int]:
 # ---------------------------------------------------------------------------
 
 
+def _kv_lo(qi, block, window):
+    """First k block a banded-causal q block attends (window in tokens)."""
+    return jnp.maximum(qi * block - (window - 1), 0) // block
+
+
+def _q_hi(kj, block, window):
+    """Last q block that attends a banded-causal k block."""
+    return (kj * block + block + window - 2) // block
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, block, causal):
+                *, scale, block, causal, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -102,7 +112,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    @pl.when((kj <= qi) if causal else (kj >= 0))
+    if causal and window is not None:
+        active = (kj <= qi) & (kj >= _kv_lo(qi, block, window))
+    else:
+        active = (kj <= qi) if causal else (kj >= 0)
+
+    @pl.when(active)
     def _compute():
         # matmul inputs stay in the storage dtype (bf16 on the hot path) —
         # the MXU runs bf16 x bf16 -> fp32 at full rate where fp32 x fp32
@@ -121,7 +136,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+            s = jnp.where(ok, s, NEG_INF)
 
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
@@ -142,21 +160,26 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m + jnp.log(l)  # (BQ, 1)
 
 
-def _flash_fwd(q, k, v, scale, block, causal=True):
+def _flash_fwd(q, k, v, scale, block, causal=True, window=None):
     """q/k/v: (BH, T, hd) -> (out (BH, T, hd), lse (BH, T, 1))."""
     bh, t, hd = q.shape
     nb = t // block
     grid = (bh, nb, nb)
     # causal: masked (above-diagonal) cells clamp their k index to the
-    # diagonal so the pipeline never fetches a block the kernel will skip
-    if causal:
+    # diagonal so the pipeline never fetches a block the kernel will skip;
+    # with a sliding window the stream is clamped from below too
+    if causal and window is not None:
+        kv_spec = pl.BlockSpec(
+            (1, block, hd),
+            lambda b, i, j: (b, jnp.clip(j, _kv_lo(i, block, window), i), 0))
+    elif causal:
         kv_spec = pl.BlockSpec(
             (1, block, hd), lambda b, i, j: (b, jnp.minimum(j, i), 0))
     else:
         kv_spec = pl.BlockSpec((1, block, hd), lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block=block,
-                          causal=causal),
+                          causal=causal, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0)),
@@ -195,7 +218,7 @@ def _flash_fwd(q, k, v, scale, block, causal=True):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, block, causal):
+               dq_scr, *, scale, block, causal, window=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -204,7 +227,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    @pl.when((kj <= qi) if causal else (kj >= 0))
+    if causal and window is not None:
+        active = (kj <= qi) & (kj >= _kv_lo(qi, block, window))
+    else:
+        active = (kj <= qi) if causal else (kj >= 0)
+
+    @pl.when(active)
     def _compute():
         # bf16 matmul inputs + fp32 accumulate (see _fwd_kernel note);
         # p/ds are computed in fp32 and cast back only to feed the MXU
@@ -223,7 +251,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
@@ -241,7 +272,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block, causal):
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block, causal,
+                window=None):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -251,8 +283,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    # causal: only q blocks at or below the diagonal see this k block
-    @pl.when((qi >= kj) if causal else (qi >= 0))
+    # causal: only q blocks at or below the diagonal see this k block;
+    # a sliding window also bounds how far below
+    if causal and window is not None:
+        active = (qi >= kj) & (qi <= _q_hi(kj, block, window))
+    else:
+        active = (qi >= kj) if causal else (qi >= 0)
+
+    @pl.when(active)
     def _compute():
         # bf16 matmul inputs + fp32 accumulate (see _fwd_kernel note)
         kblk = k_ref[0]  # (BK, hd)
@@ -270,7 +308,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+            s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse)  # (BQ, BK)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -292,7 +333,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None):
+def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
+               window=None):
     """dlse: optional cotangent for the lse output ((BH, T, 1) fp32).
 
     The lse gradient folds into the existing kernels for free:
@@ -310,8 +352,13 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None):
     nb = t // block
 
     # dq: grid (BH, q block, k block), k/v streamed; causal clamps the
-    # stream at the diagonal (skipped cells never fetch)
-    if causal:
+    # stream at the diagonal (skipped cells never fetch); a window also
+    # clamps from below
+    if causal and window is not None:
+        kv_stream = pl.BlockSpec(
+            (1, block, hd),
+            lambda b, i, j: (b, jnp.clip(j, _kv_lo(i, block, window), i), 0))
+    elif causal:
         kv_stream = pl.BlockSpec(
             (1, block, hd), lambda b, i, j: (b, jnp.minimum(j, i), 0))
     else:
@@ -320,7 +367,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None):
     vec_fixed = pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block=block,
-                          causal=causal),
+                          causal=causal, window=window),
         grid=(bh, nb, nb),
         in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, vec_fixed,
                   vec_fixed],
@@ -334,7 +381,13 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None):
     )(q, k, v, do, lse, delta)[0]
 
     # dk/dv: grid (BH, k block, q block), q/do/lse/delta streamed, clamped
-    if causal:
+    if causal and window is not None:
+        def _q_idx(b, j, i):
+            return (b, jnp.clip(i, j, _q_hi(j, block, window)), 0)
+
+        q_stream = pl.BlockSpec((1, block, hd), _q_idx)
+        vec_stream = pl.BlockSpec((1, block, 1), _q_idx)
+    elif causal:
         q_stream = pl.BlockSpec(
             (1, block, hd), lambda b, j, i: (b, jnp.maximum(i, j), 0))
         vec_stream = pl.BlockSpec(
@@ -345,7 +398,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None):
     kv_fixed = pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block=block,
-                          causal=causal),
+                          causal=causal, window=window),
         grid=(bh, nb, nb),
         in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, vec_stream,
                   vec_stream],
@@ -371,20 +424,21 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, scale: float, block: int):
-    out, _ = _flash_fwd(q, k, v, scale, block)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale: float, block: int, window=None):
+    out, _ = _flash_fwd(q, k, v, scale, block, window=window)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, block):
-    out, lse = _flash_fwd(q, k, v, scale, block)
+def _flash_fwd_rule(q, k, v, scale, block, window):
+    out, lse = _flash_fwd(q, k, v, scale, block, window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, block, res, do):
+def _flash_bwd_rule(scale, block, window, res, do):
     q, k, v, out, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, block)
+    dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, block,
+                            window=window)
     return dq, dk, dv
 
 
@@ -430,13 +484,16 @@ def causal_attention(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
     kv_offset: int | jax.Array = 0,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Drop-in for ops.attention.causal_attention, flash-accelerated.
 
     Falls back to the einsum oracle whenever the kernel doesn't apply:
     attention dropout active, decode-style q/k length mismatch, or T not
     tileable. The fallback IS the definition of correctness; the kernel is
-    tested for parity against it.
+    tested for parity against it. ``window`` enables sliding-window
+    (banded) attention — the kernel skips and never fetches blocks outside
+    the band, so compute scales with T*window instead of T^2.
     """
     b, t, h, hd = q.shape
     s = k.shape[1]
@@ -462,7 +519,7 @@ def causal_attention(
             )
         return attn_ops.causal_attention(
             q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
-            deterministic=deterministic, kv_offset=kv_offset,
+            deterministic=deterministic, kv_offset=kv_offset, window=window,
         )
     kv = k.shape[2]
     k = attn_ops.repeat_kv(k, h // kv)
@@ -470,5 +527,6 @@ def causal_attention(
     scale = 1.0 / math.sqrt(hd)
     # (B, T, H, hd) -> (B*H, T, hd)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block)
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block,
+                 None if window is None else int(window))
     return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)
