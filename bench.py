@@ -529,6 +529,27 @@ def inner() -> int:
             "seq": t_lc, "ms_per_iter": round(dt * 1e3, 2),
             "attn_tflops": round(flops / dt / 1e12, 1),
         }
+
+        # banded variant at the same shapes: the sliding-window kernel
+        # skips out-of-band blocks, so wall-clock should scale ~window/T
+        win = 1024
+
+        def attn_loss_win(q, k, v):
+            out = fa._flash(q, k, v, 1.0 / _math.sqrt(hd), 512, win)
+            return jnp.sum(out.astype(jnp.float32) ** 2)
+
+        gw = jax.jit(jax.grad(attn_loss_win, argnums=(0, 1, 2)))
+        for _ in range(2):
+            r = gw(q, k, v)
+        float(jax.device_get(r[0][0, 0, 0]))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = gw(q, k, v)
+        float(jax.device_get(r[0][0, 0, 0]))
+        dt_w = (time.perf_counter() - t0) / n
+        long_ctx["window"] = win
+        long_ctx["window_ms_per_iter"] = round(dt_w * 1e3, 2)
+        long_ctx["window_speedup"] = round(dt / dt_w, 2)
     except Exception as e:  # noqa: BLE001 — optional extra, never fatal
         print(f"long-context extra skipped: {e}", file=sys.stderr)
 

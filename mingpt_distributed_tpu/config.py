@@ -125,6 +125,13 @@ class GPTConfig:
     # (which skips out-of-band blocks: compute O(T*window), not O(T^2));
     # not composed with ring/ulysses sequence parallelism.
     attention_window: Optional[int] = None
+    # Gemma-2-style logit soft-capping: logits -> cap * tanh(logits / cap).
+    # `attn_logit_softcap` applies to attention scores before masking
+    # (einsum oracle + flash kernel; not composed with ring/ulysses);
+    # `final_logit_softcap` applies to the LM-head logits (loss, chunked
+    # loss, and generation alike). None disables.
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
     # Compute dtype for activations; params are kept in float32.
     dtype: str = "bfloat16"
     # Rematerialise each block in backward (jax.checkpoint) to trade FLOPs
@@ -234,6 +241,20 @@ class GPTConfig:
                     "attention_window (sliding-window attention) requires "
                     f"attention='einsum' or 'flash', not {self.attention!r}"
                 )
+        if self.attn_logit_softcap is not None:
+            if self.attn_logit_softcap <= 0:
+                raise ConfigError(
+                    f"attn_logit_softcap must be > 0, got {self.attn_logit_softcap}"
+                )
+            if self.attention not in ("einsum", "flash"):
+                raise ConfigError(
+                    "attn_logit_softcap requires attention='einsum' or "
+                    f"'flash', not {self.attention!r}"
+                )
+        if self.final_logit_softcap is not None and self.final_logit_softcap <= 0:
+            raise ConfigError(
+                f"final_logit_softcap must be > 0, got {self.final_logit_softcap}"
+            )
         if self.scan_unroll < 1:
             raise ConfigError(f"scan_unroll must be >= 1, got {self.scan_unroll}")
         if self.pp_schedule not in ("gpipe", "1f1b"):

@@ -78,7 +78,8 @@ def _cached_block(
     # positions correct, and the causal mask kills both future tokens and
     # never-written (zero) slots beyond offset+t
     att = attn_ops.causal_attention(
-        q, ck, cv, kv_offset=offset, window=cfg.attention_window
+        q, ck, cv, kv_offset=offset, window=cfg.attention_window,
+        logit_softcap=cfg.attn_logit_softcap,
     ).reshape(b, t, nh * hd)
     att = L.dense(att, blk["wo"], blk.get("bo"))
     x = x + att
@@ -128,6 +129,7 @@ def _forward_cached(
         "btd,dv->btv", x[:, -1:], w_head.astype(x.dtype),
         preferred_element_type=jnp.float32,
     )[:, 0]
+    logits = attn_ops.softcap(logits, cfg.final_logit_softcap)
     return logits, {"k": new_k, "v": new_v}
 
 

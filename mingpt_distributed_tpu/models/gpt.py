@@ -229,11 +229,13 @@ def _block(
         cos, sin = rope
         q = attn_ops.apply_rope(q, cos, sin)
         k = attn_ops.apply_rope(k, cos, sin)
-    # window only reaches einsum/flash (config validation); the manual-sp
-    # attn_fn override never sees it
-    attn_kw = (
-        {"window": cfg.attention_window} if cfg.attention_window else {}
-    )
+    # window/softcap only reach einsum/flash (config validation); the
+    # manual-sp attn_fn override never sees them
+    attn_kw = {}
+    if cfg.attention_window:
+        attn_kw["window"] = cfg.attention_window
+    if cfg.attn_logit_softcap:
+        attn_kw["logit_softcap"] = cfg.attn_logit_softcap
     att = (attn_fn or _attention_dispatch(cfg, mesh))(
         q, k, v,
         attn_pdrop=cfg.attn_pdrop,
@@ -508,6 +510,7 @@ def forward(
             "btd,dv->btv", x, w_head.astype(x.dtype),
             preferred_element_type=jnp.float32,
         )
+        logits = attn_ops.softcap(logits, cfg.final_logit_softcap)
 
     loss = None
     if targets is not None:
@@ -519,7 +522,8 @@ def forward(
             # When logits are requested they exist anyway, so dense CE
             # costs no extra memory — no chunking in that case.
             loss = chunked_cross_entropy(
-                x, w_head.astype(x.dtype), targets, nc
+                x, w_head.astype(x.dtype), targets, nc,
+                softcap=cfg.final_logit_softcap,
             )
         else:
             loss = cross_entropy(logits, targets)
@@ -542,7 +546,8 @@ def cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
 
 
 def chunked_cross_entropy(
-    x: jax.Array, w_head: jax.Array, targets: jax.Array, n_chunks: int
+    x: jax.Array, w_head: jax.Array, targets: jax.Array, n_chunks: int,
+    softcap: Optional[float] = None,
 ) -> jax.Array:
     """Same math as ``cross_entropy(x @ w_head, targets)``, but the head
     matmul + log-softmax run per sequence chunk under ``jax.checkpoint``:
@@ -561,6 +566,7 @@ def chunked_cross_entropy(
         logits = jnp.einsum(
             "bcd,dv->bcv", xc, w_head, preferred_element_type=jnp.float32
         )
+        logits = attn_ops.softcap(logits, softcap)
         valid = tc != -1
         safe = jnp.where(valid, tc, 0)
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)

@@ -27,6 +27,15 @@ import jax.numpy as jnp
 NEG_INF = -1e30  # large-finite instead of -inf: keeps softmax NaN-free in bf16
 
 
+def softcap(x: jax.Array, cap: Optional[float]) -> jax.Array:
+    """Gemma-2-style logit soft-capping: cap * tanh(x / cap); identity when
+    cap is None/0. One definition shared by the oracle, the LM-head paths
+    and decode (the Pallas kernels inline it — kernel code can't call out)."""
+    if not cap:
+        return x
+    return cap * jnp.tanh(x / cap)
+
+
 def repeat_kv(k: jax.Array, n_rep: int) -> jax.Array:
     """Expand KV heads for grouped-query attention: (B,S,KV,hd)->(B,S,KV*rep,hd)."""
     if n_rep == 1:
@@ -47,6 +56,7 @@ def causal_attention(
     deterministic: bool = True,
     kv_offset: int | jax.Array = 0,
     window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """Causal scaled-dot-product attention, softmax in float32.
 
@@ -55,7 +65,8 @@ def causal_attention(
     decoding (so a single query attends to all cached keys).
     ``window`` enables sliding-window (banded) attention: each query sees
     only the last ``window`` positions, itself included (Mistral-style;
-    ``None`` = full causal).
+    ``None`` = full causal). ``logit_softcap`` applies Gemma-2-style
+    ``cap * tanh(logits / cap)`` to the scores before masking.
     Returns (B, T, H, hd) in q's dtype.
     """
     b, t, h, hd = q.shape
@@ -68,6 +79,7 @@ def causal_attention(
     logits = jnp.einsum(
         "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
     ) * scale
+    logits = softcap(logits, logit_softcap)
 
     s = k.shape[1]
     q_pos = jnp.arange(t)[:, None] + kv_offset  # absolute query positions

@@ -101,7 +101,7 @@ def _q_hi(kj, block, window):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, block, causal, window=None):
+                *, scale, block, causal, window=None, softcap=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -131,6 +131,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # (BQ, BK)
+        if softcap is not None:  # Gemma-2 soft-cap, before masking
+            s = softcap * jnp.tanh(s / softcap)
         if causal:
             q_pos = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -160,7 +162,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = m + jnp.log(l)  # (BQ, 1)
 
 
-def _flash_fwd(q, k, v, scale, block, causal=True, window=None):
+def _flash_fwd(q, k, v, scale, block, causal=True, window=None, softcap=None):
     """q/k/v: (BH, T, hd) -> (out (BH, T, hd), lse (BH, T, 1))."""
     bh, t, hd = q.shape
     nb = t // block
@@ -179,7 +181,7 @@ def _flash_fwd(q, k, v, scale, block, causal=True, window=None):
         kv_spec = pl.BlockSpec((1, block, hd), lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block=block,
-                          causal=causal, window=window),
+                          causal=causal, window=window, softcap=softcap),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0)),
@@ -218,7 +220,7 @@ def _flash_fwd(q, k, v, scale, block, causal=True, window=None):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, block, causal, window=None):
+               dq_scr, *, scale, block, causal, window=None, softcap=None):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -246,6 +248,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        if softcap is not None:
+            # keep the UNMASKED capped scores for the tanh derivative: the
+            # factor stays bounded in [0, 1] (masked entries would overflow)
+            s = softcap * jnp.tanh(s / softcap)
+        sc = s
         if causal:
             q_pos = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -260,7 +267,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta.astype(jnp.float32)) * scale
+        ds = p * (dp - delta.astype(jnp.float32))
+        if softcap is not None:  # chain through d/ds cap*tanh(s/cap)
+            ds = ds * (1.0 - (sc / softcap) ** 2)
+        ds = ds * scale
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -273,7 +283,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block, causal,
-                window=None):
+                window=None, softcap=None):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -303,6 +313,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
+        if softcap is not None:
+            s = softcap * jnp.tanh(s / softcap)
+        sc = s  # unmasked capped scores (tanh-derivative factor)
         if causal:
             q_pos = qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -321,7 +334,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta.astype(jnp.float32)) * scale
+        ds = p * (dp - delta.astype(jnp.float32))
+        if softcap is not None:
+            ds = ds * (1.0 - (sc / softcap) ** 2)
+        ds = ds * scale
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -334,7 +350,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
-               window=None):
+               window=None, softcap=None):
     """dlse: optional cotangent for the lse output ((BH, T, 1) fp32).
 
     The lse gradient folds into the existing kernels for free:
@@ -367,7 +383,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
     vec_fixed = pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block=block,
-                          causal=causal, window=window),
+                          causal=causal, window=window, softcap=softcap),
         grid=(bh, nb, nb),
         in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, vec_fixed,
                   vec_fixed],
@@ -398,7 +414,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
     kv_fixed = pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block=block,
-                          causal=causal, window=window),
+                          causal=causal, window=window, softcap=softcap),
         grid=(bh, nb, nb),
         in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, vec_stream,
                   vec_stream],
@@ -424,21 +440,21 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale: float, block: int, window=None):
-    out, _ = _flash_fwd(q, k, v, scale, block, window=window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale: float, block: int, window=None, softcap=None):
+    out, _ = _flash_fwd(q, k, v, scale, block, window=window, softcap=softcap)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, block, window):
-    out, lse = _flash_fwd(q, k, v, scale, block, window=window)
+def _flash_fwd_rule(q, k, v, scale, block, window, softcap):
+    out, lse = _flash_fwd(q, k, v, scale, block, window=window, softcap=softcap)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(scale, block, window, res, do):
+def _flash_bwd_rule(scale, block, window, softcap, res, do):
     q, k, v, out, lse = res
     dq, dk, dv = _flash_bwd(q, k, v, out, lse, do, scale, block,
-                            window=window)
+                            window=window, softcap=softcap)
     return dq, dk, dv
 
 
@@ -485,6 +501,7 @@ def causal_attention(
     deterministic: bool = True,
     kv_offset: int | jax.Array = 0,
     window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """Drop-in for ops.attention.causal_attention, flash-accelerated.
 
@@ -520,6 +537,7 @@ def causal_attention(
         return attn_ops.causal_attention(
             q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
             deterministic=deterministic, kv_offset=kv_offset, window=window,
+            logit_softcap=logit_softcap,
         )
     kv = k.shape[2]
     k = attn_ops.repeat_kv(k, h // kv)
@@ -528,5 +546,6 @@ def causal_attention(
     # (B, T, H, hd) -> (B*H, T, hd)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
     out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block,
-                 None if window is None else int(window))
+                 None if window is None else int(window),
+                 None if logit_softcap is None else float(logit_softcap))
     return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)
