@@ -535,7 +535,9 @@ def inner() -> int:
         win = 1024
 
         def attn_loss_win(q, k, v):
-            out = fa._flash(q, k, v, 1.0 / _math.sqrt(hd), 512, win)
+            # keyword args: _flash's positional nondiff layout has already
+            # changed once (softcap appended) — don't depend on it
+            out = fa._flash(q, k, v, 1.0 / _math.sqrt(hd), 512, window=win)
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
         gw = jax.jit(jax.grad(attn_loss_win, argnums=(0, 1, 2)))
