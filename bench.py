@@ -484,12 +484,14 @@ def inner() -> int:
             "n_devices": jax.device_count(),
             "paths": per_path,
             "long_context": long_ctx,
+            "decode": decode,  # KV-cached greedy decode extra (TPU only)
         }
         print(json.dumps(record), flush=True)
 
-    # headline record FIRST: if the optional long-context extra below hangs
-    # or dies, the outer process parses the last complete JSON line and the
+    # headline record FIRST: if the optional extras below hang or die, the
+    # outer process parses the last complete JSON line and the
     # already-measured MFU is never lost
+    decode = None
     emit(None)
 
     # long-context line (SURVEY §5.7): one bounded flash fwd+bwd at T=8192 —
@@ -555,7 +557,50 @@ def inner() -> int:
     except Exception as e:  # noqa: BLE001 — optional extra, never fatal
         print(f"long-context extra skipped: {e}", file=sys.stderr)
 
-    if long_ctx is not None:
+    # decode throughput extra — LAST, so a slow compile here can't starve
+    # the longer-standing long-context metric out of the record (SURVEY C9:
+    # the reference re-forwards the whole sequence per token; the KV-cached
+    # compiled decode is a capability worth a number). The rate is the
+    # DIFFERENTIAL between two generation lengths, so the shared prefill
+    # forward cancels and pure decode-step throughput is reported.
+    try:
+        if jax.default_backend() != "tpu":
+            raise RuntimeError("decode extra is TPU-only")
+        from mingpt_distributed_tpu.models import generate as gen_mod
+
+        dec_cfg = GPTConfig.make(
+            model_type=model,
+            embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+            dtype="bfloat16", block_size=max(seq, 1024),
+        )
+        dec_params = jax.jit(lambda k: gpt.init(k, dec_cfg))(jax.random.key(4))
+        db, prompt_len = 8, 128
+        n_short, n_long = 256, 512
+        prompt = jax.random.randint(
+            jax.random.key(5), (db, prompt_len), 0, dec_cfg.vocab_size,
+            dtype=jnp.int32,
+        )
+
+        def timed(n):
+            out = gen_mod.generate(dec_params, dec_cfg, prompt, n)
+            int(jax.device_get(out[0, -1]))  # compile + sync
+            t0 = time.perf_counter()
+            out = gen_mod.generate(dec_params, dec_cfg, prompt, n)
+            int(jax.device_get(out[0, -1]))
+            return time.perf_counter() - t0
+
+        dt_short, dt_long = timed(n_short), timed(n_long)
+        if dt_long > dt_short:
+            decode = {
+                "batch": db, "prompt_len": prompt_len,
+                "new_tokens": n_long,
+                "decode_tokens_per_sec": round(
+                    db * (n_long - n_short) / (dt_long - dt_short), 1),
+            }
+    except Exception as e:  # noqa: BLE001 — optional extra, never fatal
+        print(f"decode extra skipped: {e}", file=sys.stderr)
+
+    if long_ctx is not None or decode is not None:
         emit(long_ctx)  # augmented record supersedes the headline-only one
     return 0
 
