@@ -14,10 +14,10 @@ from mingpt_distributed_tpu.parallel import mesh as mesh_lib
 from mingpt_distributed_tpu.parallel.ring_attention import ring_causal_attention
 
 
-def sp_mesh(dp=1, sp=8):
+def sp_mesh(dp=1, sp=8, tp=1):
     return mesh_lib.make_mesh(
-        MeshConfig(dp=dp, fsdp=1, tp=1, sp=sp),
-        devices=jax.devices()[: dp * sp],
+        MeshConfig(dp=dp, fsdp=1, tp=tp, sp=sp),
+        devices=jax.devices()[: dp * tp * sp],
     )
 
 
@@ -182,5 +182,17 @@ def test_zigzag_ring_kernel_work_is_exact_causal_share(eight_devices, monkeypatc
 
     # correctness unchanged by the placement
     want = attn_ops.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ring_with_tp_sharded_heads(eight_devices):
+    """The public ring path shards heads over tp when divisible
+    (head_ax='tp' in its shard_map specs): dp=2 x sp=2 x tp=2 must still
+    match the dense oracle — heads are just batch to the ring."""
+    mesh = sp_mesh(dp=2, sp=2, tp=2)
+    q, k, v = qkv(b=2, t=64, h=4, hd=16, seed=17)
+    want = attn_ops.causal_attention(q, k, v)
+    got = jax.jit(lambda *a: ring_causal_attention(*a, mesh))(q, k, v)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
