@@ -457,3 +457,23 @@ def test_pp_tp_sp_triple_composition(eight_devices):
     for a, b in zip(jax.tree.leaves(g_got), jax.tree.leaves(g_want)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=5e-4, atol=5e-4)
+
+
+def test_pp_tp_flash_window_softcap(eight_devices):
+    """The Pallas flash kernel — with sliding window AND logit softcap —
+    runs inside the pipeline's manual region composed with megatron-tp:
+    logits must match the dense single-device run."""
+    cfg, params, tokens = cfg_and_inputs(
+        attention="flash", attention_window=8, attn_logit_softcap=10.0
+    )
+    want_logits, want_loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=2, sp=1), devices=eight_devices
+    )
+    got_logits, got_loss = jax.jit(
+        lambda p, t: gpt.forward(p, t, cfg, targets=t, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(
+        np.asarray(got_logits), np.asarray(want_logits), rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
