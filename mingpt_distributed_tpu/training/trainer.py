@@ -206,13 +206,21 @@ class GPTTrainer:
         self.optimizer = make_optimizer(
             optimizer_config, config.grad_norm_clip, schedule=self._lr_fn
         )
+        # How the global batch's ROWS split across processes is a property
+        # of the batch SHARDING, not always of process_count: when a
+        # non-batch axis spans hosts (e.g. sequence parallelism over DCN,
+        # mesh sp across processes) every process addresses all rows and
+        # must feed the full batch.
+        self._feed_count, self._feed_index = self._data_feed_shards(
+            config.batch_size, train_dataset.block_size
+        )
         self.train_iter = ShardedBatchIterator(
             train_dataset,
             config.batch_size,
             shuffle=True,
             seed=config.seed,
-            process_index=self.process_index,
-            process_count=self.process_count,
+            process_index=self._feed_index,
+            process_count=self._feed_count,
         )
         self.test_iter = (
             ShardedBatchIterator(
@@ -220,8 +228,8 @@ class GPTTrainer:
                 config.batch_size,
                 shuffle=False,
                 seed=config.seed,
-                process_index=self.process_index,
-                process_count=self.process_count,
+                process_index=self._feed_index,
+                process_count=self._feed_count,
             )
             if test_dataset is not None and len(test_dataset) >= config.batch_size
             else None
@@ -338,10 +346,42 @@ class GPTTrainer:
             "step": jnp.asarray(0, dtype=jnp.int32),
         }
 
+    def _data_feed_shards(self, global_batch: int, seq_len: int):
+        """(n_shards, my_shard) for host data feeding.
+
+        Derived from ``batch_sharding``'s device->index map: the rows this
+        process's local devices address. Pure dp/fsdp/ep over hosts gives
+        the usual equal contiguous split; a mesh whose batch rows are NOT
+        cleanly process-partitioned (sp spanning hosts, or exotic layouts)
+        degrades to every host feeding the full batch, which
+        make_array_from_process_local_data accepts (host data may match the
+        global shape).
+        """
+        if self.process_count == 1:
+            return 1, 0
+        rows: set = set()
+        m = mesh_lib.batch_sharding(self.mesh).devices_indices_map(
+            (global_batch, seq_len)
+        )
+        for d, idx in m.items():
+            if d.process_index == jax.process_index():
+                rows.update(range(*idx[0].indices(global_batch)))
+        my = sorted(rows)
+        n_rows = len(my)
+        contiguous = my == list(range(my[0], my[0] + n_rows))
+        if (
+            n_rows == global_batch
+            or not contiguous
+            or global_batch % n_rows
+            or my[0] % n_rows
+        ):
+            return 1, 0  # feed the full batch on every host
+        return global_batch // n_rows, my[0] // n_rows
+
     def _put_batch(self, xy: Tuple[np.ndarray, np.ndarray]):
         """Per-host local shard -> global device array under batch sharding."""
         x, y = xy
-        gshape = (x.shape[0] * self.process_count, x.shape[1])
+        gshape = (x.shape[0] * self._feed_count, x.shape[1])
         if self.process_count == 1:
             put = lambda a: jax.device_put(a, self.batch_sharding)
         else:

@@ -41,22 +41,32 @@ def main() -> int:
         "multi host training shards the batch across processes and gathers "
         "snapshots from every host before writing. " * 30
     )
+    # "sp_ring" needs a longer context: T=64 over sp=4 gives 16-token
+    # chunks whose 8-token half-chunks are flash-tileable, so the ZIGZAG
+    # ring path runs — with the ring's ppermute hops crossing the process
+    # (DCN) boundary, not just virtual intra-process devices.
+    block = 64 if mesh_kind == "sp_ring" else 16
     ds = CharDataset(
-        DataConfig(path="<inline>", block_size=16, train_split=0.9), text=corpus
+        DataConfig(path="<inline>", block_size=block, train_split=0.9),
+        text=corpus,
     )
     train, test = ds.split()
     gcfg = GPTConfig.make(
         n_layer=2, n_head=2, n_embd=32, vocab_size=ds.vocab_size,
-        block_size=16, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        block_size=block, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
         dtype="float32",
+        attention="ring" if mesh_kind == "sp_ring" else "einsum",
     )
     # "dp2": 2 procs x 1 device, pure data parallel (the reference's shape).
     # "hybrid": 2 procs x 4 devices — dp crosses the process (DCN) boundary
     # while fsdp/tp ride the intra-process (ICI) axes, the scaling-book
     # hybrid-mesh recipe; exercises cross-host param gathers + tp collectives.
+    # "sp_ring": 2 procs x 2 devices — the sequence axis spans BOTH
+    # processes; ring attention's neighbour exchanges cross DCN.
     mesh_cfg = {
         "dp2": MeshConfig(dp=2, fsdp=1, tp=1, sp=1),
         "hybrid": MeshConfig(dp=2, fsdp=2, tp=2, sp=1),
+        "sp_ring": MeshConfig(dp=1, fsdp=1, tp=1, sp=4),
     }[mesh_kind]
     tcfg = TrainerConfig.make(
         max_epochs=1, batch_size=8, grad_norm_clip=1.0, save_every=100,

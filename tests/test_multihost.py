@@ -123,3 +123,19 @@ def test_hybrid_mesh_two_hosts(tmp_path):
     assert os.path.exists(snap)
     saved_notices = [("Snapshot saved" in log) for log in logs]
     assert sum(saved_notices) == 1
+
+
+@pytest.mark.slow
+def test_ring_attention_across_process_boundary(tmp_path):
+    """2 processes x 2 local devices with sp=4: the zigzag ring's ppermute
+    hops (and its entry/exit redistribution) cross the process (DCN)
+    boundary — long-context sequence parallelism the way a real pod would
+    run it, not just virtual devices in one process."""
+    snap = str(tmp_path / "mh_ring.msgpack")
+    results, logs = _run_pair(snap, max_steps=3, mesh="sp_ring",
+                              local_devices=2)
+    assert results[0]["end_step"] == 3 and results[1]["end_step"] == 3
+    assert abs(results[0]["eval_loss"] - results[1]["eval_loss"]) < 1e-6
+    assert os.path.exists(snap)
+    saved_notices = [("Snapshot saved" in log) for log in logs]
+    assert sum(saved_notices) == 1

@@ -466,7 +466,13 @@ def test_pp_tp_flash_window_softcap(eight_devices):
     cfg, params, tokens = cfg_and_inputs(
         attention="flash", attention_window=8, attn_logit_softcap=10.0
     )
-    want_logits, want_loss = gpt.forward(params, tokens, cfg, targets=tokens)
+    # reference run uses the EINSUM oracle so a kernel bug can't cancel
+    # out on both sides — this asserts kernel AND composition at once
+    import dataclasses
+
+    cfg_oracle = dataclasses.replace(cfg, attention="einsum")
+    want_logits, want_loss = gpt.forward(
+        params, tokens, cfg_oracle, targets=tokens)
     mesh = mesh_lib.make_mesh(
         MeshConfig(pp=2, dp=2, fsdp=1, tp=2, sp=1), devices=eight_devices
     )
