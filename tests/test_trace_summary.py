@@ -1,0 +1,140 @@
+"""tools/trace_summary.py against a real CPU-captured jax.profiler trace.
+
+VERDICT r3 weak #2: the trace summarizer is the instrument the round-4
+perf analysis stands on, and it had zero tests — a parsing bug would
+silently corrupt the evidence chain.  ``jax.profiler.trace`` works on CPU,
+so this captures a tiny real trace in CI and asserts the summarizer's
+structure end-to-end, plus unit-tests the busy-time interval-union logic
+on synthetic overlapping events.
+"""
+
+import gzip
+import json
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import trace_summary  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def cpu_trace_dir(tmp_path_factory):
+    """Capture a real trace of a jitted matmul loop on CPU."""
+    outdir = str(tmp_path_factory.mktemp("trace"))
+
+    @jax.jit
+    def f(x):
+        return x @ x + jnp.sin(x)
+
+    x = jnp.ones((256, 256), jnp.float32)
+    f(x).block_until_ready()  # compile outside the trace
+    with jax.profiler.trace(outdir):
+        for _ in range(3):
+            x = f(x)
+        x.block_until_ready()
+    return outdir
+
+
+def test_load_trace_finds_real_capture(cpu_trace_dir):
+    trace = trace_summary.load_trace(cpu_trace_dir)
+    events = trace["traceEvents"]
+    assert events, "captured trace has no events"
+    # the capture must contain complete events (ph=X) with durations —
+    # that's the only event type summarize() aggregates
+    xs = [e for e in events if e.get("ph") == "X"]
+    assert xs, "no complete (ph=X) events in the captured trace"
+    assert any(float(e.get("dur", 0)) > 0 for e in xs)
+
+
+def test_summarize_real_capture_structure(cpu_trace_dir):
+    trace = trace_summary.load_trace(cpu_trace_dir)
+    out = trace_summary.summarize(trace, top=5)
+    text = "\n".join(out)
+    assert out[0].startswith("trace span:")
+    span_ms = float(out[0].split("trace span:")[1].split("ms")[0])
+    assert span_ms > 0
+    assert "== lane " in text, "no lanes summarised"
+    # every lane's busy time must be <= the trace span (union logic):
+    # a plain sum over nested events would exceed it on real traces
+    for line in out:
+        if line.startswith("\n== lane ") or line.startswith("== lane "):
+            busy_ms = float(line.split("busy ")[1].split(" ms")[0])
+            assert busy_ms <= span_ms * 1.001, line
+
+
+def test_main_end_to_end(cpu_trace_dir, capsys):
+    rc = trace_summary.main([cpu_trace_dir, "--top", "3"])
+    assert rc == 0
+    cap = capsys.readouterr()
+    assert "trace span:" in cap.out
+    assert "== lane " in cap.out
+
+
+def test_main_missing_dir(tmp_path, capsys):
+    rc = trace_summary.main([str(tmp_path / "nope")])
+    assert rc == 1
+    assert "no *.trace.json.gz" in capsys.readouterr().err
+
+
+def _fake_trace(events):
+    return {"traceEvents": events}
+
+
+def test_busy_union_on_overlapping_events():
+    """Nested/overlapping events must not double-count busy time."""
+    events = [
+        {"ph": "M", "name": "process_name", "pid": 1,
+         "args": {"name": "devlane"}},
+        {"ph": "M", "name": "thread_name", "pid": 1, "tid": 2,
+         "args": {"name": "XLA Ops"}},
+        # outer op 0..100us with a nested op 10..60us (python-stack style)
+        {"ph": "X", "pid": 1, "tid": 2, "name": "outer", "ts": 0.0,
+         "dur": 100.0},
+        {"ph": "X", "pid": 1, "tid": 2, "name": "inner", "ts": 10.0,
+         "dur": 50.0},
+        # disjoint op 200..250us
+        {"ph": "X", "pid": 1, "tid": 2, "name": "tail", "ts": 200.0,
+         "dur": 50.0},
+    ]
+    out = trace_summary.summarize(_fake_trace(events), top=10)
+    text = "\n".join(out)
+    # span = 0..250us = 0.25ms; busy union = (0..100) + (200..250) = 0.15ms
+    assert "trace span: 0.25 ms" in out[0]
+    assert "busy 0.15 ms" in text
+    # per-op table is inclusive (like trace viewers): outer keeps its 100us
+    assert "outer" in text and "inner" in text and "tail" in text
+
+
+def test_busy_union_chained_extension():
+    """Events that chain-extend (a overlaps b, b overlaps c) merge into one
+    interval — the sweep must extend the current interval's end, not reset."""
+    events = [
+        {"ph": "X", "pid": 9, "tid": 1, "name": "a", "ts": 0.0, "dur": 30.0},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "b", "ts": 20.0, "dur": 30.0},
+        {"ph": "X", "pid": 9, "tid": 1, "name": "c", "ts": 40.0, "dur": 30.0},
+    ]
+    out = trace_summary.summarize(_fake_trace(events), top=10)
+    # one merged interval 0..70us = 0.07ms busy over a 0.07ms span
+    assert "busy 0.07 ms" in "\n".join(out)
+
+
+def test_multihost_pid_namespacing(tmp_path):
+    """Two hosts' trace files must keep separate lanes (pid collision)."""
+    run = tmp_path / "plugins" / "profile" / "run1"
+    run.mkdir(parents=True)
+    for host in ("hostA", "hostB"):
+        t = {"traceEvents": [
+            {"ph": "X", "pid": 7, "tid": 0, "name": f"op_{host}",
+             "ts": 0.0, "dur": 10.0},
+        ]}
+        with gzip.open(run / f"{host}.trace.json.gz", "wt") as f:
+            json.dump(t, f)
+    trace = trace_summary.load_trace(str(tmp_path))
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert pids == {"hostA:7", "hostB:7"}
+    out = "\n".join(trace_summary.summarize(trace, top=5))
+    assert "op_hostA" in out and "op_hostB" in out
