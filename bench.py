@@ -53,13 +53,29 @@ BENCH_TIMEOUT_S = 2400
 # Error signatures worth retrying: tunnel/backend reachability flaps. A
 # permanent failure (ImportError, bad venv) answers in ~1 s and must fail
 # fast rather than burn the full retry budget on an unwinnable probe.
+# Signatures are SPECIFIC (grpc status names, errno phrases) rather than
+# bare substrings like "connection" — an ImportError whose message merely
+# mentions a module named connection must not burn ~28 min of retries.
 _TRANSIENT_MARKERS = (
-    "timed out", "unavailable", "deadline", "connection", "connect",
-    "socket", "unreachable", "reset", "refused", "no json",
+    "timed out", "unavailable", "deadline_exceeded", "deadline exceeded",
+    "connection refused", "connection reset", "failed to connect",
+    "unreachable", "socket closed", "no json",
+)
+
+# Exception types the probe subprocess can classify itself: these answer
+# instantly and no retry can fix them.
+_PERMANENT_ETYPES = (
+    "ImportError", "ModuleNotFoundError", "SyntaxError", "AttributeError",
+    "NameError",
 )
 
 
-def _is_transient(msg: str) -> bool:
+def _is_transient(msg: str, etype: str | None = None) -> bool:
+    """Structured etype (from the probe subprocess) beats substring
+    matching; the markers are the fallback for crashes that die before
+    printing JSON."""
+    if etype in _PERMANENT_ETYPES:
+        return False
     low = msg.lower()
     return any(m in low for m in _TRANSIENT_MARKERS)
 
@@ -75,12 +91,23 @@ def _error_record(msg: str) -> dict:
 
 
 def _probe_backend() -> dict:
-    """Check jax.devices() answers within a bound; never imports jax here."""
+    """Check jax.devices() answers within a bound; never imports jax here.
+
+    The subprocess catches its own exception and reports the TYPE, so the
+    parent classifies transient-vs-permanent structurally instead of by
+    substring-matching a traceback (ADVICE r3: 'connect' in a module path
+    must not look like a tunnel flap)."""
     code = (
-        "import json, jax\n"
-        "d = jax.devices()[0]\n"
-        "print(json.dumps({'platform': d.platform,"
+        "import json, sys\n"
+        "try:\n"
+        "    import jax\n"
+        "    d = jax.devices()[0]\n"
+        "    print(json.dumps({'platform': d.platform,"
         " 'kind': d.device_kind, 'n': jax.device_count()}))\n"
+        "except Exception as e:\n"
+        "    print(json.dumps({'error': str(e)[:400] or type(e).__name__,"
+        " 'etype': type(e).__name__}))\n"
+        "    sys.exit(0)\n"
     )
     try:
         proc = subprocess.run(
@@ -114,7 +141,7 @@ def _probe_backend_with_retry() -> dict:
             f"probe attempt {attempt}/{PROBE_ATTEMPTS}: {last['error']}",
             file=sys.stderr,
         )
-        if not _is_transient(last["error"]):
+        if not _is_transient(last["error"], last.get("etype")):
             return last  # permanent: retrying can't fix an ImportError
         if attempt < PROBE_ATTEMPTS:
             time.sleep(PROBE_BACKOFF_S)
@@ -145,6 +172,34 @@ def check_throughput_plausible(
             f"> {slack}x chip peak {peak_flops / 1e12:.1f} TFLOP/s — the "
             "D2H sync is not actually synchronizing on this backend; "
             "refusing to report inflated numbers"
+        )
+
+
+def check_decode_plausible(
+    decode_tokens_per_sec: float,
+    batch: int,
+    param_bytes: float,
+    peak_hbm_bytes: float | None,
+    slack: float = 1.5,
+) -> None:
+    """Roofline honesty guard for the decode extra (VERDICT r3 next #8).
+
+    KV-cached decode is memory-bound: every decode step streams the full
+    parameter set from HBM once (shared across the batch), so steps/sec
+    cannot exceed bandwidth / param-bytes.  The differential D2H timing
+    the decode extra uses is exposed to the same backend sync quirk as the
+    train-step path; refuse a rate that implies more than ``slack``× the
+    chip's HBM bandwidth rather than report it.
+    """
+    if peak_hbm_bytes is None or not decode_tokens_per_sec:
+        return
+    required = (decode_tokens_per_sec / batch) * param_bytes
+    if required > slack * peak_hbm_bytes:
+        raise RuntimeError(
+            f"implausible decode rate: {decode_tokens_per_sec:.0f} tok/s at "
+            f"batch {batch} implies {required / 1e9:.0f} GB/s of parameter "
+            f"streaming > {slack}x chip HBM bandwidth "
+            f"{peak_hbm_bytes / 1e9:.0f} GB/s — timing did not synchronize"
         )
 
 
@@ -298,6 +353,7 @@ def inner() -> int:
     from mingpt_distributed_tpu.training.metrics import (
         flops_per_token,
         peak_flops_per_chip,
+        peak_hbm_bytes_per_chip,
     )
     from mingpt_distributed_tpu.training.optimizer import make_optimizer
     from mingpt_distributed_tpu.training.trainer import make_train_step
@@ -439,9 +495,24 @@ def inner() -> int:
         tps = sps * batch * seq
         return tps, (tps * fpt / peak if peak else None)
 
+    # plausibility-gate EVERY path, not just the eventual headline (ADVICE
+    # r3): an implausible per-path record is as dishonest in the artifact
+    # as an implausible headline
     per_path = {}
-    for attention, (batch, sps) in results.items():
+    for attention in list(results):
+        batch, sps = results[attention]
         tps, mfu = mfu_of(batch, sps)
+        try:
+            check_throughput_plausible(tps, fpt, peak)
+        except RuntimeError as e:
+            print(f"{attention} path refused: {e}", file=sys.stderr)
+            del results[attention]
+            if attention == "flash":
+                # the sweep's winning block was measured by a refused
+                # timing — don't report it or let it steer the extras
+                flash_block = None
+                os.environ.pop("FLASH_BLOCK", None)
+            continue
         per_path[attention] = {
             "batch": batch,
             "tokens_per_sec_per_chip": round(tps, 1),
@@ -449,6 +520,11 @@ def inner() -> int:
             "scan_unroll": unrolls.get(attention, 1),
             "remat": remats.get(attention, False),
         }
+    if not results:
+        print(json.dumps(_error_record(
+            "every attention path implied > 1.2x chip peak — the D2H sync "
+            "is not synchronizing on this backend; refusing to report")))
+        return 0
 
     best = max(
         results,
@@ -456,11 +532,6 @@ def inner() -> int:
     )
     batch, sps = results[best]
     tokens_per_sec, mfu = mfu_of(batch, sps)
-    try:
-        check_throughput_plausible(tokens_per_sec, fpt, peak)
-    except RuntimeError as e:
-        print(json.dumps(_error_record(str(e))))
-        return 0
 
     def emit(long_ctx):
         dev = jax.devices()[0]
@@ -527,6 +598,10 @@ def inner() -> int:
         dt = (time.perf_counter() - t0) / n
         # causal fwd 2 matmuls: 4*bh*T^2*hd/2 flops; bwd ~2.5x more
         flops = 3.5 * 4 * bh * t_lc * t_lc * hd / 2
+        if peak and flops / dt > 1.2 * peak:
+            raise RuntimeError(
+                f"implausible long-context timing: {flops / dt / 1e12:.0f} "
+                f"TFLOP/s > 1.2x peak {peak / 1e12:.0f}")
         long_ctx = {
             "seq": t_lc, "ms_per_iter": round(dt * 1e3, 2),
             "attn_tflops": round(flops / dt / 1e12, 1),
@@ -551,9 +626,16 @@ def inner() -> int:
             r = gw(q, k, v)
         float(jax.device_get(r[0][0, 0, 0]))
         dt_w = (time.perf_counter() - t0) / n
-        long_ctx["window"] = win
-        long_ctx["window_ms_per_iter"] = round(dt_w * 1e3, 2)
-        long_ctx["window_speedup"] = round(dt / dt_w, 2)
+        # banded rows attend ~window keys vs the causal average T/2, so
+        # banded work ~= full * 2*win/T; same 1.2x-peak refusal applies
+        flops_w = flops * 2 * win / t_lc
+        if peak and flops_w / dt_w > 1.2 * peak:
+            print(f"banded extra refused: {flops_w / dt_w / 1e12:.0f} "
+                  f"TFLOP/s implied > 1.2x peak", file=sys.stderr)
+        else:
+            long_ctx["window"] = win
+            long_ctx["window_ms_per_iter"] = round(dt_w * 1e3, 2)
+            long_ctx["window_speedup"] = round(dt / dt_w, 2)
     except Exception as e:  # noqa: BLE001 — optional extra, never fatal
         print(f"long-context extra skipped: {e}", file=sys.stderr)
 
@@ -591,11 +673,16 @@ def inner() -> int:
 
         dt_short, dt_long = timed(n_short), timed(n_long)
         if dt_long > dt_short:
+            dtps = db * (n_long - n_short) / (dt_long - dt_short)
+            # bf16 compute copy of the params is the floor of per-step HBM
+            # traffic (KV-cache reads come on top — bound is conservative)
+            check_decode_plausible(
+                dtps, db, 2 * gpt.param_count(dec_params),
+                peak_hbm_bytes_per_chip())
             decode = {
                 "batch": db, "prompt_len": prompt_len,
                 "new_tokens": n_long,
-                "decode_tokens_per_sec": round(
-                    db * (n_long - n_short) / (dt_long - dt_short), 1),
+                "decode_tokens_per_sec": round(dtps, 1),
             }
     except Exception as e:  # noqa: BLE001 — optional extra, never fatal
         print(f"decode extra skipped: {e}", file=sys.stderr)

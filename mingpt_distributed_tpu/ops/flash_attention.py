@@ -75,7 +75,11 @@ def _block_sizes(t: int) -> Optional[int]:
             ob = int(override)
         except ValueError:
             ob = 0
-        if ob >= 8 and t % ob == 0:
+        # Clamp to the validated ladder range: above 512 the (block, block)
+        # fp32 scratch outgrows VMEM and Mosaic compile fails at trace time,
+        # and a process-global env var would poison ring-attention dispatch
+        # for every caller, not just the sweep that set it.
+        if 8 <= ob <= 512 and t % ob == 0:
             return ob
     for b in (512, 256, 128):
         if t % b == 0:
@@ -141,6 +145,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             ok = q_pos >= k_pos
             if window is not None:
                 ok = ok & (q_pos - k_pos < window)
+            # INVARIANT (wipe-by-underflow): when window < block, a q-row's
+            # first active k-block can be FULLY masked — this tile is then
+            # all NEG_INF, so m_new = NEG_INF and p = exp(0) = 1 garbage
+            # transiently enters acc/l below. Correctness relies on every
+            # q-row's LAST active block holding a live diagonal key, so the
+            # later rescale alpha = exp(NEG_INF - m_finite) underflows to
+            # exactly 0.0 and wipes the garbage. Changing NEG_INF to a
+            # value exp() doesn't flush to zero, or seeding m/l/acc
+            # differently, silently breaks banded attention
+            # (guard tests: t=384 / window=16 in test_window_attention.py).
             s = jnp.where(ok, s, NEG_INF)
 
         m = m_scr[...]

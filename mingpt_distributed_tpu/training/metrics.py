@@ -29,12 +29,35 @@ PEAK_FLOPS: dict[str, float] = {
 }
 
 
-def peak_flops_per_chip() -> Optional[float]:
+# Peak HBM bandwidth per chip (bytes/s), for memory-bound rooflines
+# (KV-cached decode streams the whole parameter set per token, so its
+# ceiling is bandwidth, not FLOPs). Public numbers.
+PEAK_HBM_BYTES: dict[str, float] = {
+    "TPU v4": 1228e9,
+    "TPU v5 lite": 819e9,  # v5e
+    "TPU v5e": 819e9,
+    "TPU v5": 2765e9,  # v5p
+    "TPU v5p": 2765e9,
+    "TPU v6 lite": 1640e9,  # v6e (Trillium)
+}
+
+
+def _chip_lookup(table: dict[str, float]) -> Optional[float]:
+    # longest-prefix-wins by dict order: "TPU v5 lite" is listed before
+    # "TPU v5" in both tables, so v5e doesn't read the v5p row
     kind = jax.devices()[0].device_kind
-    for name, val in PEAK_FLOPS.items():
+    for name, val in table.items():
         if kind.startswith(name):
             return val
     return None
+
+
+def peak_flops_per_chip() -> Optional[float]:
+    return _chip_lookup(PEAK_FLOPS)
+
+
+def peak_hbm_bytes_per_chip() -> Optional[float]:
+    return _chip_lookup(PEAK_HBM_BYTES)
 
 
 def flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
