@@ -231,26 +231,18 @@ class GPTConfig:
             )
         if self.attention not in ("einsum", "flash", "ring", "ulysses"):
             raise ConfigError(f"unknown attention impl {self.attention!r}")
-        if self.attention_window is not None:
-            if self.attention_window < 1:
-                raise ConfigError(
-                    f"attention_window must be >= 1, got {self.attention_window}"
-                )
-            if self.attention not in ("einsum", "flash"):
-                raise ConfigError(
-                    "attention_window (sliding-window attention) requires "
-                    f"attention='einsum' or 'flash', not {self.attention!r}"
-                )
-        if self.attn_logit_softcap is not None:
-            if self.attn_logit_softcap <= 0:
-                raise ConfigError(
-                    f"attn_logit_softcap must be > 0, got {self.attn_logit_softcap}"
-                )
-            if self.attention not in ("einsum", "flash"):
-                raise ConfigError(
-                    "attn_logit_softcap requires attention='einsum' or "
-                    f"'flash', not {self.attention!r}"
-                )
+        # window/softcap compose with every attention impl, including the
+        # sequence-parallel ones: the ring turns banded with static hop
+        # skipping and ulysses holds the full sequence locally (r4 —
+        # parallel/ring_attention.py, parallel/ulysses.py)
+        if self.attention_window is not None and self.attention_window < 1:
+            raise ConfigError(
+                f"attention_window must be >= 1, got {self.attention_window}"
+            )
+        if self.attn_logit_softcap is not None and self.attn_logit_softcap <= 0:
+            raise ConfigError(
+                f"attn_logit_softcap must be > 0, got {self.attn_logit_softcap}"
+            )
         if self.final_logit_softcap is not None and self.final_logit_softcap <= 0:
             raise ConfigError(
                 f"final_logit_softcap must be > 0, got {self.final_logit_softcap}"

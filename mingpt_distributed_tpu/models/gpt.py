@@ -162,16 +162,19 @@ def _manual_sp_attention(cfg: GPTConfig):
     to open a nested shard_map, which JAX forbids."""
     from mingpt_distributed_tpu.parallel import ring_attention, ulysses
 
-    def fn(q, k, v, *, attn_pdrop=0.0, dropout_key=None, deterministic=True):
+    def fn(q, k, v, *, attn_pdrop=0.0, dropout_key=None, deterministic=True,
+           window=None, logit_softcap=None):
         del attn_pdrop, dropout_key, deterministic  # gated by the caller
         h, hd = q.shape[2], q.shape[3]
         k2 = attn_ops.repeat_kv(k, h // k.shape[2])
         v2 = attn_ops.repeat_kv(v, h // v.shape[2])
         if cfg.attention == "ring":
             return ring_attention._ring_shard(
-                q, k2, v2, axis_name="sp", scale=1.0 / math.sqrt(hd)
+                q, k2, v2, axis_name="sp", scale=1.0 / math.sqrt(hd),
+                window=window, softcap=logit_softcap,
             )
-        return ulysses._ulysses_shard(q, k2, v2, axis_name="sp")
+        return ulysses._ulysses_shard(q, k2, v2, axis_name="sp",
+                                      window=window, softcap=logit_softcap)
 
     return fn
 
@@ -229,8 +232,8 @@ def _block(
         cos, sin = rope
         q = attn_ops.apply_rope(q, cos, sin)
         k = attn_ops.apply_rope(k, cos, sin)
-    # window/softcap only reach einsum/flash (config validation); the
-    # manual-sp attn_fn override never sees them
+    # window/softcap compose with every attention impl, including the
+    # manual-sp attn_fn override inside pipeline stages
     attn_kw = {}
     if cfg.attention_window:
         attn_kw["window"] = cfg.attention_window

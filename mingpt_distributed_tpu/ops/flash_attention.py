@@ -94,18 +94,35 @@ def _block_sizes(t: int) -> Optional[int]:
 # ---------------------------------------------------------------------------
 
 
-def _kv_lo(qi, block, window):
-    """First k block a banded-causal q block attends (window in tokens)."""
-    return jnp.maximum(qi * block - (window - 1), 0) // block
+def _kv_lo(qi, block, window, q_offset=0):
+    """First k block a banded-causal q block attends (window in tokens).
+
+    ``q_offset`` shifts the q block's global position: the ring's
+    cross-chunk hops (parallel/ring_attention.py) reuse these kernels with
+    q sitting ``q_offset`` tokens after k, so the band runs diagonally
+    through the (q, k) block grid instead of hugging the main diagonal.
+    """
+    return jnp.maximum(q_offset + qi * block - (window - 1), 0) // block
 
 
-def _q_hi(kj, block, window):
+def _kv_hi(qi, block, q_offset, nk):
+    """Last k block with any causally-visible key for this q block."""
+    return jnp.minimum((q_offset + qi * block + block - 1) // block, nk - 1)
+
+
+def _q_lo(kj, block, q_offset):
+    """First q block that causally sees a k block (q_offset as above)."""
+    return jnp.maximum(kj * block - q_offset, 0) // block
+
+
+def _q_hi(kj, block, window, q_offset=0):
     """Last q block that attends a banded-causal k block."""
-    return (kj * block + block + window - 2) // block
+    return (kj * block + block + window - 2 - q_offset) // block
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, block, causal, window=None, softcap=None):
+                *, scale, block, causal, window=None, softcap=None,
+                q_offset=0):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -117,9 +134,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
     if causal and window is not None:
-        active = (kj <= qi) & (kj >= _kv_lo(qi, block, window))
+        active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
+            kj >= _kv_lo(qi, block, window, q_offset))
     else:
-        active = (kj <= qi) if causal else (kj >= 0)
+        active = (kj <= _kv_hi(qi, block, q_offset, nk)) if causal \
+            else (kj >= 0)
 
     @pl.when(active)
     def _compute():
@@ -138,7 +157,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         if softcap is not None:  # Gemma-2 soft-cap, before masking
             s = softcap * jnp.tanh(s / softcap)
         if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
+            q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
@@ -155,6 +174,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             # value exp() doesn't flush to zero, or seeding m/l/acc
             # differently, silently breaks banded attention
             # (guard tests: t=384 / window=16 in test_window_attention.py).
+            # With q_offset > 0 a row can be dead in EVERY block (the band
+            # passed it entirely). Its m then stays NEG_INF through the
+            # whole sweep (l accrues exp(0)=1 garbage per masked entry, it
+            # does NOT stay 0), so finalize emits lse = m + log(l) ~=
+            # NEG_INF and LSE-merging callers fold the garbage `out` away
+            # with weight exp(NEG_INF - m_finite) = 0. m, not l, is the
+            # dead-row signature.
             s = jnp.where(ok, s, NEG_INF)
 
         m = m_scr[...]
@@ -172,30 +198,47 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     @pl.when(kj == nk - 1)
     def _finalize():
         m, l, acc = m_scr[...], l_scr[...], acc_scr[...]
-        o_ref[0] = (acc / l).astype(o_ref.dtype)
-        lse_ref[0] = m + jnp.log(l)  # (BQ, 1)
+        # max(l, tiny): a dead q BLOCK (no active kj at all, q_offset > 0)
+        # reaches here with l = 0 and would emit 0/0 = NaN; dead rows
+        # inside an ACTIVE block instead carry l = masked-entry garbage
+        # with m = NEG_INF. Both cases emit lse ~= NEG_INF (m + log(l)),
+        # which LSE-merging callers weight to exactly zero — `out` for
+        # dead rows is garbage by contract, lse is the signal. Live rows
+        # have l >= exp(0) = 1 from their max entry, so values are exact.
+        l_safe = jnp.maximum(l, 1e-30)
+        o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = m + jnp.log(l_safe)  # (BQ, 1)
 
 
-def _flash_fwd(q, k, v, scale, block, causal=True, window=None, softcap=None):
+def _flash_fwd(q, k, v, scale, block, causal=True, window=None, softcap=None,
+               q_offset=0):
     """q/k/v: (BH, T, hd) -> (out (BH, T, hd), lse (BH, T, 1))."""
     bh, t, hd = q.shape
     nb = t // block
     grid = (bh, nb, nb)
     # causal: masked (above-diagonal) cells clamp their k index to the
     # diagonal so the pipeline never fetches a block the kernel will skip;
-    # with a sliding window the stream is clamped from below too
+    # with a sliding window the stream is clamped from below too. A
+    # q_offset>0 block whose whole band misses this k chunk has lo > hi:
+    # clip then returns hi (already in [0, nb-1]) as the
+    # fetched-but-skipped placeholder index.
     if causal and window is not None:
         kv_spec = pl.BlockSpec(
             (1, block, hd),
-            lambda b, i, j: (b, jnp.clip(j, _kv_lo(i, block, window), i), 0))
+            lambda b, i, j: (b, jnp.clip(
+                j, _kv_lo(i, block, window, q_offset),
+                _kv_hi(i, block, q_offset, nb)), 0))
     elif causal:
         kv_spec = pl.BlockSpec(
-            (1, block, hd), lambda b, i, j: (b, jnp.minimum(j, i), 0))
+            (1, block, hd),
+            lambda b, i, j: (b, jnp.minimum(j, _kv_hi(i, block, q_offset,
+                                                      nb)), 0))
     else:
         kv_spec = pl.BlockSpec((1, block, hd), lambda b, i, j: (b, j, 0))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block=block,
-                          causal=causal, window=window, softcap=softcap),
+                          causal=causal, window=window, softcap=softcap,
+                          q_offset=q_offset),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0)),
@@ -234,7 +277,8 @@ def _flash_fwd(q, k, v, scale, block, causal=True, window=None, softcap=None):
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale, block, causal, window=None, softcap=None):
+               dq_scr, *, scale, block, causal, window=None, softcap=None,
+               q_offset=0):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -244,9 +288,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
     if causal and window is not None:
-        active = (kj <= qi) & (kj >= _kv_lo(qi, block, window))
+        active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
+            kj >= _kv_lo(qi, block, window, q_offset))
     else:
-        active = (kj <= qi) if causal else (kj >= 0)
+        active = (kj <= _kv_hi(qi, block, q_offset, nk)) if causal \
+            else (kj >= 0)
 
     @pl.when(active)
     def _compute():
@@ -267,8 +313,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             # factor stays bounded in [0, 1] (masked entries would overflow)
             s = softcap * jnp.tanh(s / softcap)
         sc = s
+        p = None
         if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
+            q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
@@ -276,7 +323,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             if window is not None:
                 ok = ok & (q_pos - k_pos < window)
             s = jnp.where(ok, s, NEG_INF)
-        p = jnp.exp(s - lse)
+            # mask p structurally, not via exp underflow: a dead row
+            # (q_offset > 0, no live key) has lse ~= NEG_INF, making
+            # exp(NEG_INF - lse) = exp(~0) = 1 garbage rather than 0
+            p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+        if p is None:
+            p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -297,7 +349,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block, causal,
-                window=None, softcap=None):
+                window=None, softcap=None, q_offset=0):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -307,12 +359,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    # causal: only q blocks at or below the diagonal see this k block;
-    # a sliding window also bounds how far below
+    # causal: only q blocks at or below the (offset) diagonal see this k
+    # block; a sliding window also bounds how far below
     if causal and window is not None:
-        active = (qi >= kj) & (qi <= _q_hi(kj, block, window))
+        active = (qi >= _q_lo(kj, block, q_offset)) & (
+            qi <= _q_hi(kj, block, window, q_offset))
     else:
-        active = (qi >= kj) if causal else (qi >= 0)
+        active = (qi >= _q_lo(kj, block, q_offset)) if causal \
+            else (qi >= 0)
 
     @pl.when(active)
     def _compute():
@@ -330,8 +384,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if softcap is not None:
             s = softcap * jnp.tanh(s / softcap)
         sc = s  # unmasked capped scores (tanh-derivative factor)
+        p = None
         if causal:
-            q_pos = qi * block + jax.lax.broadcasted_iota(
+            q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 1)
@@ -339,7 +394,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             if window is not None:
                 ok = ok & (q_pos - k_pos < window)
             s = jnp.where(ok, s, NEG_INF)
-        p = jnp.exp(s - lse)  # (BQ, BK)
+            # structural masking — see _dq_kernel's dead-row note
+            p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+        if p is None:
+            p = jnp.exp(s - lse)  # (BQ, BK)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -364,7 +422,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
-               window=None, softcap=None):
+               window=None, softcap=None, q_offset=0):
     """dlse: optional cotangent for the lse output ((BH, T, 1) fp32).
 
     The lse gradient folds into the existing kernels for free:
@@ -385,19 +443,26 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
     # stream at the diagonal (skipped cells never fetch); a window also
     # clamps from below
     if causal and window is not None:
+        # lo > hi (band misses the chunk) resolves to hi via clip — a
+        # valid placeholder index; see the fwd kv_spec note
         kv_stream = pl.BlockSpec(
             (1, block, hd),
-            lambda b, i, j: (b, jnp.clip(j, _kv_lo(i, block, window), i), 0))
+            lambda b, i, j: (b, jnp.clip(
+                j, _kv_lo(i, block, window, q_offset),
+                _kv_hi(i, block, q_offset, nb)), 0))
     elif causal:
         kv_stream = pl.BlockSpec(
-            (1, block, hd), lambda b, i, j: (b, jnp.minimum(j, i), 0))
+            (1, block, hd),
+            lambda b, i, j: (b, jnp.minimum(j, _kv_hi(i, block, q_offset,
+                                                      nb)), 0))
     else:
         kv_stream = pl.BlockSpec((1, block, hd), lambda b, i, j: (b, j, 0))
     q_fixed = pl.BlockSpec((1, block, hd), lambda b, i, j: (b, i, 0))
     vec_fixed = pl.BlockSpec((1, block, 1), lambda b, i, j: (b, i, 0))
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block=block,
-                          causal=causal, window=window, softcap=softcap),
+                          causal=causal, window=window, softcap=softcap,
+                          q_offset=q_offset),
         grid=(bh, nb, nb),
         in_specs=[q_fixed, kv_stream, kv_stream, q_fixed, vec_fixed,
                   vec_fixed],
@@ -413,22 +478,27 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
     # dk/dv: grid (BH, k block, q block), q/do/lse/delta streamed, clamped
     if causal and window is not None:
         def _q_idx(b, j, i):
-            return (b, jnp.clip(i, j, _q_hi(j, block, window)), 0)
+            return (b, jnp.clip(jnp.clip(
+                i, _q_lo(j, block, q_offset),
+                _q_hi(j, block, window, q_offset)), 0, nb - 1), 0)
 
         q_stream = pl.BlockSpec((1, block, hd), _q_idx)
         vec_stream = pl.BlockSpec((1, block, 1), _q_idx)
     elif causal:
         q_stream = pl.BlockSpec(
-            (1, block, hd), lambda b, j, i: (b, jnp.maximum(i, j), 0))
+            (1, block, hd),
+            lambda b, j, i: (b, jnp.maximum(i, _q_lo(j, block, q_offset)), 0))
         vec_stream = pl.BlockSpec(
-            (1, block, 1), lambda b, j, i: (b, jnp.maximum(i, j), 0))
+            (1, block, 1),
+            lambda b, j, i: (b, jnp.maximum(i, _q_lo(j, block, q_offset)), 0))
     else:
         q_stream = pl.BlockSpec((1, block, hd), lambda b, j, i: (b, i, 0))
         vec_stream = pl.BlockSpec((1, block, 1), lambda b, j, i: (b, i, 0))
     kv_fixed = pl.BlockSpec((1, block, hd), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block=block,
-                          causal=causal, window=window, softcap=softcap),
+                          causal=causal, window=window, softcap=softcap,
+                          q_offset=q_offset),
         grid=(bh, nb, nb),
         in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, vec_stream,
                   vec_stream],
@@ -475,8 +545,10 @@ def _flash_bwd_rule(scale, block, window, softcap, res, do):
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def flash_with_lse(q, k, v, scale: float, block: int, causal: bool = True):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_with_lse(q, k, v, scale: float, block: int, causal: bool = True,
+                   window: Optional[int] = None,
+                   softcap: Optional[float] = None, q_offset: int = 0):
     """(q, k, v) (BH, T, hd) -> (out (BH, T, hd), lse (BH, T, 1) fp32).
 
     The building block for distributed attention (parallel/ring_attention.py):
@@ -484,20 +556,31 @@ def flash_with_lse(q, k, v, scale: float, block: int, causal: bool = True):
     log-sum-exp, so a ring hop can run this kernel per chunk and combine —
     differentiable in both outputs (the lse cotangent folds into delta,
     see _flash_bwd).
+
+    ``window``/``softcap`` mirror the square-kernel options; ``q_offset``
+    places the q chunk that many tokens after the k chunk (banded ring
+    cross-chunk hops). Rows left with no live key under an offset band
+    return garbage ``out`` and lse ~= NEG_INF — callers MUST merge by lse
+    (the weight underflows to exactly 0), not read ``out`` directly.
     """
-    return _flash_fwd(q, k, v, scale, block, causal)
+    return _flash_fwd(q, k, v, scale, block, causal, window=window,
+                      softcap=softcap, q_offset=q_offset)
 
 
-def _flash_lse_fwd_rule(q, k, v, scale, block, causal):
-    out, lse = _flash_fwd(q, k, v, scale, block, causal)
+def _flash_lse_fwd_rule(q, k, v, scale, block, causal, window, softcap,
+                        q_offset):
+    out, lse = _flash_fwd(q, k, v, scale, block, causal, window=window,
+                          softcap=softcap, q_offset=q_offset)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd_rule(scale, block, causal, res, cts):
+def _flash_lse_bwd_rule(scale, block, causal, window, softcap, q_offset,
+                        res, cts):
     q, k, v, out, lse = res
     do, dlse = cts
     dq, dk, dv = _flash_bwd(
-        q, k, v, out, lse, do, scale, block, causal=causal, dlse=dlse
+        q, k, v, out, lse, do, scale, block, causal=causal, dlse=dlse,
+        window=window, softcap=softcap, q_offset=q_offset,
     )
     return dq, dk, dv
 

@@ -43,36 +43,125 @@ from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
 NEG_INF = -1e30
 
 
-def _ring_shard(q, k, v, *, axis_name: str, scale: float):
+def _ring_shard(q, k, v, *, axis_name: str, scale: float,
+                window: Optional[int] = None,
+                softcap: Optional[float] = None):
     """Per-shard ring attention. q/k/v: (b, c, h, hd) local chunks.
 
-    Dispatch: when the local half-chunk is tileable, the zigzag flash ring
-    runs — every hop carries equal, fully-useful causal work (see
-    ``_ring_shard_flash_zigzag``). When only the full chunk is tileable,
-    the contiguous flash ring runs (correct but ~2x the kernel work: future
-    chunks are computed then folded with zero weight). Otherwise the fp32
-    einsum fold below is the oracle.
+    Dispatch: with a sliding window the banded ring runs — a contiguous
+    ring that statically executes ONLY the hops whose chunk offset can
+    intersect the band (see ``_ring_shard_flash_banded``); zigzag's
+    load-balancing rationale is moot under a band, where per-query work is
+    already uniform. Full-causal: when the local half-chunk is tileable,
+    the zigzag flash ring runs — every hop carries equal, fully-useful
+    causal work (see ``_ring_shard_flash_zigzag``). When only the full
+    chunk is tileable, the contiguous flash ring runs (correct but ~2x the
+    kernel work: future chunks are computed then folded with zero weight).
+    Otherwise the fp32 einsum fold below is the oracle. ``softcap``
+    composes with every path (the kernels apply it before masking).
     """
     from mingpt_distributed_tpu.ops import flash_attention as fa
 
     c = q.shape[1]
     n = jax.lax.psum(1, axis_name)
+    if window is not None:
+        block = fa.supported_block(c)
+        if n > 1 and block is not None:
+            return _ring_shard_flash_banded(
+                q, k, v, axis_name=axis_name, scale=scale, block=block,
+                window=window, softcap=softcap,
+            )
+        return _ring_shard_einsum(q, k, v, axis_name=axis_name, scale=scale,
+                                  window=window, softcap=softcap)
     if n > 1 and c % 2 == 0:
         half_block = fa.supported_block(c // 2)
         if half_block is not None:
             return _ring_shard_flash_zigzag(
-                q, k, v, axis_name=axis_name, scale=scale, block=half_block
+                q, k, v, axis_name=axis_name, scale=scale, block=half_block,
+                softcap=softcap,
             )
     block = fa.supported_block(c)
     if block is not None:
         return _ring_shard_flash(
-            q, k, v, axis_name=axis_name, scale=scale, block=block
+            q, k, v, axis_name=axis_name, scale=scale, block=block,
+            softcap=softcap,
         )
-    return _ring_shard_einsum(q, k, v, axis_name=axis_name, scale=scale)
+    return _ring_shard_einsum(q, k, v, axis_name=axis_name, scale=scale,
+                              softcap=softcap)
+
+
+def _ring_shard_flash_banded(q, k, v, *, axis_name: str, scale: float,
+                             block: int, window: int,
+                             softcap: Optional[float] = None):
+    """Banded (sliding-window) ring attention with static hop skipping.
+
+    With a window of W tokens over chunks of c tokens, a strictly-past
+    chunk t hops back sits at offset D = t*c; its NEAREST key is D-(c-1)
+    behind the query, so the chunk intersects the band iff
+    t*c <= W + c - 2. The hop loop therefore runs only
+
+        t_live = min(n-1, (W + c - 2) // c)
+
+    hops — K/V chunks beyond the band are never rotated, never fetched,
+    never computed: ring compute AND communication scale with T*W instead
+    of T^2/2 (VERDICT r3 next #5: the model family that motivates
+    sliding-window attention gets the sp axis that motivates long
+    context). Per hop:
+
+      - fully in-band pair (D + c - 1 < W): unmasked non-causal kernel;
+      - boundary pair: the offset-banded kernel (q_offset = D) — its
+        block-skipping prunes out-of-band tiles inside the chunk too.
+
+    Wrapped sources (src > idx: future chunks) fold with weight 0 exactly
+    like the contiguous ring; rows whose whole band precedes the received
+    chunk emit lse ~= NEG_INF from the kernel and merge to zero weight
+    (see flash_with_lse's dead-row contract).
+    """
+    from mingpt_distributed_tpu.ops import flash_attention as fa
+
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    b, c, h, hd = q.shape
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, c, hd)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    # step 0 — own (diagonal) chunk: square banded-causal kernel; every
+    # live row sees its diagonal key, so the running state starts NaN-free
+    o0, lse0 = fa.flash_with_lse(qb, kb, vb, scale, block, True,
+                                 window, softcap, 0)
+    m, l, acc = lse0, jnp.ones_like(lse0), o0.astype(jnp.float32)
+
+    t_live = min(n - 1, (window + c - 2) // c)
+    shift = [(j, (j + 1) % n) for j in range(n)]
+    kc, vc = kb, vb
+    # python loop, not lax.scan: q_offset is a static kernel parameter that
+    # differs per hop, and t_live is small (~window/c + 1) by construction
+    for t in range(1, t_live + 1):
+        kc = jax.lax.ppermute(kc, axis_name, shift)
+        vc = jax.lax.ppermute(vc, axis_name, shift)
+        d = t * c
+        if d + c - 1 < window:
+            # whole chunk pair inside the band: no masking needed at all
+            oi, lsei = fa.flash_with_lse(qb, kc, vc, scale, block, False,
+                                         None, softcap, 0)
+        else:
+            oi, lsei = fa.flash_with_lse(qb, kc, vc, scale, block, True,
+                                         window, softcap, d)
+        src = (idx - t) % n
+        lsei = jnp.where(src < idx, lsei, NEG_INF)  # wrap = future chunk
+        m_new = jnp.maximum(m, lsei)
+        alpha = jnp.exp(m - m_new)
+        w = jnp.exp(lsei - m_new)
+        m, l = m_new, l * alpha + w
+        acc = acc * alpha + w * oi.astype(jnp.float32)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return out.reshape(b, h, c, hd).transpose(0, 2, 1, 3)
 
 
 def _ring_shard_flash_zigzag(q, k, v, *, axis_name: str, scale: float,
-                             block: int):
+                             block: int, softcap: Optional[float] = None):
     """Zigzag ring attention (VERDICT r2 weak #2 / next #3).
 
     The contiguous ring gives device i all of chunk i: under causal masking
@@ -141,9 +230,12 @@ def _ring_shard_flash_zigzag(q, k, v, *, axis_name: str, scale: float,
     # step 0 — own pair: early x early and late x late are diagonal
     # (causal), late x early is strictly past (full). Every query row sees
     # >= 1 key, so both running states start finite and NaN-free.
-    o_ee, lse_ee = fa.flash_with_lse(qe, ke, ve, scale, block, True)
-    o_ll, lse_ll = fa.flash_with_lse(ql, kl, vl, scale, block, True)
-    o_le, lse_le = fa.flash_with_lse(ql, ke, ve, scale, block, False)
+    o_ee, lse_ee = fa.flash_with_lse(qe, ke, ve, scale, block, True,
+                                     None, softcap, 0)
+    o_ll, lse_ll = fa.flash_with_lse(ql, kl, vl, scale, block, True,
+                                     None, softcap, 0)
+    o_le, lse_le = fa.flash_with_lse(ql, ke, ve, scale, block, False,
+                                     None, softcap, 0)
     early = (lse_ee, jnp.ones_like(lse_ee), o_ee.astype(jnp.float32))
     late = fold((lse_ll, jnp.ones_like(lse_ll), o_ll.astype(jnp.float32)),
                 o_le, lse_le)
@@ -163,7 +255,8 @@ def _ring_shard_flash_zigzag(q, k, v, *, axis_name: str, scale: float,
         q2 = jnp.concatenate([jnp.where(past, qe, ql), ql], axis=0)
         k2 = jnp.concatenate([kec, jnp.where(past, kec, klc)], axis=0)
         v2 = jnp.concatenate([vec, jnp.where(past, vec, vlc)], axis=0)
-        o2, lse2 = fa.flash_with_lse(q2, k2, v2, scale, block, False)
+        o2, lse2 = fa.flash_with_lse(q2, k2, v2, scale, block, False,
+                                     None, softcap, 0)
         o_a, o_b = o2[:bh], o2[bh:]
         lse_a, lse_b = lse2[:bh], lse2[bh:]
         # element a belongs to early iff past; element b is always late
@@ -192,7 +285,8 @@ def _ring_shard_flash_zigzag(q, k, v, *, axis_name: str, scale: float,
     return out.reshape(b, h, c, hd).transpose(0, 2, 1, 3)
 
 
-def _ring_shard_flash(q, k, v, *, axis_name: str, scale: float, block: int):
+def _ring_shard_flash(q, k, v, *, axis_name: str, scale: float, block: int,
+                      softcap: Optional[float] = None):
     """Flash-kernel ring: the diagonal chunk runs the causal kernel; every
     rotated chunk runs the non-causal kernel and is folded via its
     log-sum-exp (future chunks fold with lse = -inf, i.e. exactly zero
@@ -214,7 +308,8 @@ def _ring_shard_flash(q, k, v, *, axis_name: str, scale: float, block: int):
     # hop inside the scan) removes 2*(n-1) layout copies per layer per step
     # step 0: own (diagonal) chunk, causal — every query row sees >= 1 key,
     # so the running state starts NaN-free
-    o0, lse0 = fa.flash_with_lse(qb, kb, vb, scale, block, True)
+    o0, lse0 = fa.flash_with_lse(qb, kb, vb, scale, block, True,
+                                 None, softcap, 0)
     m0 = lse0  # (bh, c, 1) fp32
     l0 = jnp.ones_like(lse0)  # exp(lse0 - m0)
     acc0 = o0.astype(jnp.float32)
@@ -226,7 +321,8 @@ def _ring_shard_flash(q, k, v, *, axis_name: str, scale: float, block: int):
         kc = jax.lax.ppermute(kc, axis_name, shift)
         vc = jax.lax.ppermute(vc, axis_name, shift)
         src = (idx - i) % n  # origin device of the chunk we now hold
-        oi, lsei = fa.flash_with_lse(qb, kc, vc, scale, block, False)
+        oi, lsei = fa.flash_with_lse(qb, kc, vc, scale, block, False,
+                                     None, softcap, 0)
         # strictly-past chunks contribute; future chunks fold with zero
         # weight (finite NEG_INF keeps exp() well-defined)
         lsei = jnp.where(src < idx, lsei, NEG_INF)
@@ -244,7 +340,9 @@ def _ring_shard_flash(q, k, v, *, axis_name: str, scale: float, block: int):
     return out.reshape(b, h, c, hd).transpose(0, 2, 1, 3)
 
 
-def _ring_shard_einsum(q, k, v, *, axis_name: str, scale: float):
+def _ring_shard_einsum(q, k, v, *, axis_name: str, scale: float,
+                       window: Optional[int] = None,
+                       softcap: Optional[float] = None):
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
     b, c, h, hd = q.shape
@@ -260,8 +358,13 @@ def _ring_shard_einsum(q, k, v, *, axis_name: str, scale: float):
             "bthd,bshd->bhts", qf, kc.astype(jnp.float32),
             preferred_element_type=jnp.float32,
         )
+        if softcap is not None:  # Gemma-2 soft-cap, before masking
+            s = softcap * jnp.tanh(s / softcap)
         k_pos = src * c + k_local
-        s = jnp.where((q_pos >= k_pos)[None, None], s, NEG_INF)
+        ok = q_pos >= k_pos
+        if window is not None:
+            ok = ok & (q_pos - k_pos < window)
+        s = jnp.where(ok[None, None], s, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -306,9 +409,17 @@ def ring_causal_attention(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
     kv_offset: int | jax.Array = 0,
+    window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """Sequence-parallel causal attention (einsum-oracle fallback when the
-    ring doesn't apply: no mesh / sp==1 / dropout / decode shapes)."""
+    ring doesn't apply: no mesh / sp==1 / dropout / decode shapes).
+
+    ``window``/``logit_softcap`` compose with the ring (VERDICT r3 next
+    #5): a sliding window turns the ring banded with static hop skipping
+    (see _ring_shard_flash_banded), so the mistral-family presets can
+    sequence-parallelize their long contexts.
+    """
     b, t, h, hd = q.shape
     usable = (
         mesh is not None
@@ -322,7 +433,8 @@ def ring_causal_attention(
     if not usable:
         return attn_ops.causal_attention(
             q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
-            deterministic=deterministic, kv_offset=kv_offset,
+            deterministic=deterministic, kv_offset=kv_offset, window=window,
+            logit_softcap=logit_softcap,
         )
     kv = k.shape[2]
     k = attn_ops.repeat_kv(k, h // kv)
@@ -332,7 +444,10 @@ def ring_causal_attention(
     head_ax = "tp" if h % mesh.shape.get("tp", 1) == 0 else None
     spec = P(BATCH_AXES, "sp", head_ax, None)
     fn = jax.shard_map(
-        partial(_ring_shard, axis_name="sp", scale=scale),
+        partial(_ring_shard, axis_name="sp", scale=scale,
+                window=None if window is None else int(window),
+                softcap=None if logit_softcap is None
+                else float(logit_softcap)),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

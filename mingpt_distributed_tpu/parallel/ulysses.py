@@ -31,8 +31,14 @@ from mingpt_distributed_tpu.ops import flash_attention as flash
 from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
 
 
-def _ulysses_shard(q, k, v, *, axis_name: str):
-    """Per-shard: (b, T/n, H, hd) -> attention output, via two all-to-alls."""
+def _ulysses_shard(q, k, v, *, axis_name: str, window=None, softcap=None):
+    """Per-shard: (b, T/n, H, hd) -> attention output, via two all-to-alls.
+
+    ``window``/``softcap`` compose for free: after the first all-to-all
+    each device holds the FULL sequence for its head group, so the local
+    banded/soft-capped kernel is exactly the dense semantics — no
+    cross-chunk band bookkeeping as in the ring.
+    """
     # seq-sharded/all-heads -> head-sharded/full-seq
     a2a = partial(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1,
@@ -41,7 +47,8 @@ def _ulysses_shard(q, k, v, *, axis_name: str):
     qh, kh, vh = a2a(q), a2a(k), a2a(v)  # (b, T, H/n, hd)
     # local attention over the full sequence for this head group; the flash
     # wrapper picks the Pallas kernel when shapes allow, einsum otherwise
-    out = flash.causal_attention(qh, kh, vh)
+    out = flash.causal_attention(qh, kh, vh, window=window,
+                                 logit_softcap=softcap)
     # head-sharded/full-seq -> seq-sharded/all-heads
     return jax.lax.all_to_all(
         out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -58,6 +65,8 @@ def ulysses_causal_attention(
     dropout_key: Optional[jax.Array] = None,
     deterministic: bool = True,
     kv_offset: int | jax.Array = 0,
+    window: Optional[int] = None,
+    logit_softcap: Optional[float] = None,
 ) -> jax.Array:
     """All-to-all sequence-parallel causal attention (oracle fallback when
     the strategy doesn't apply)."""
@@ -76,14 +85,18 @@ def ulysses_causal_attention(
     if not usable:
         return attn_ops.causal_attention(
             q, k, v, attn_pdrop=attn_pdrop, dropout_key=dropout_key,
-            deterministic=deterministic, kv_offset=kv_offset,
+            deterministic=deterministic, kv_offset=kv_offset, window=window,
+            logit_softcap=logit_softcap,
         )
     kv = k.shape[2]
     k = attn_ops.repeat_kv(k, h // kv)
     v = attn_ops.repeat_kv(v, h // kv)
     spec = P(BATCH_AXES, "sp", None, None)
     fn = jax.shard_map(
-        partial(_ulysses_shard, axis_name="sp"),
+        partial(_ulysses_shard, axis_name="sp",
+                window=None if window is None else int(window),
+                softcap=None if logit_softcap is None
+                else float(logit_softcap)),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

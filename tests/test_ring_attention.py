@@ -162,10 +162,11 @@ def test_zigzag_ring_kernel_work_is_exact_causal_share(eight_devices, monkeypatc
     calls = []
     real = fa.flash_with_lse
 
-    def counting(q, k, v, scale, block, causal=True):
+    def counting(q, k, v, scale, block, causal=True, window=None,
+                 softcap=None, q_offset=0):
         # work units: batch * q_len * k_len, causal diagonal counts half
         calls.append(q.shape[0] * q.shape[1] * k.shape[1] * (0.5 if causal else 1.0))
-        return real(q, k, v, scale, block, causal)
+        return real(q, k, v, scale, block, causal, window, softcap, q_offset)
 
     monkeypatch.setattr(fa, "flash_with_lse", counting)
     mesh = sp_mesh(dp=1, sp=sp)

@@ -122,9 +122,11 @@ def test_softcap_generation_matches_dense_oracle():
 def test_softcap_config_validation():
     with pytest.raises(ConfigError, match="attn_logit_softcap"):
         GPTConfig.make(n_layer=2, n_head=2, n_embd=32, attn_logit_softcap=0.0)
-    with pytest.raises(ConfigError, match="attn_logit_softcap"):
-        GPTConfig.make(n_layer=2, n_head=2, n_embd=32, attention="ring",
-                       attn_logit_softcap=5.0)
+    # r4: softcap composes with the sp attentions — accepted, not refused
+    for attention in ("ring", "ulysses"):
+        cfg = GPTConfig.make(n_layer=2, n_head=2, n_embd=32,
+                             attention=attention, attn_logit_softcap=5.0)
+        assert cfg.attn_logit_softcap == 5.0
     with pytest.raises(ConfigError, match="final_logit_softcap"):
         GPTConfig.make(n_layer=2, n_head=2, n_embd=32,
                        final_logit_softcap=-1.0)

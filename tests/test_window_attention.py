@@ -159,6 +159,9 @@ def test_mistral_presets_resolve():
 def test_window_config_validation():
     with pytest.raises(ConfigError, match="attention_window"):
         GPTConfig.make(n_layer=2, n_head=2, n_embd=32, attention_window=0)
-    with pytest.raises(ConfigError, match="sliding-window"):
-        GPTConfig.make(n_layer=2, n_head=2, n_embd=32, attention="ring",
-                       attention_window=8)
+    # r4: the window composes with the sp attentions (banded ring / local
+    # ulysses) — these configs are now accepted, not refused
+    for attention in ("ring", "ulysses"):
+        cfg = GPTConfig.make(n_layer=2, n_head=2, n_embd=32,
+                             attention=attention, attention_window=8)
+        assert cfg.attention_window == 8
