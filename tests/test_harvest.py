@@ -1,0 +1,161 @@
+"""tools/harvest.py contract: serial stages, artifact index, resume.
+
+Mirrors tests/test_bench.py's approach — fake stages (tiny python -c
+scripts) stand in for the chip-touching commands, so the probe -> run ->
+index -> resume machinery is CI-tested on CPU without hardware.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_harvest(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "harvest", os.path.join(REPO, "tools", "harvest.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # redirect the index into the sandbox so tests never touch the repo's
+    monkeypatch.setattr(mod, "INDEX", str(tmp_path / "HARVEST.json"))
+    return mod
+
+
+def _ok_stage(name, tmp_path, marker=None):
+    art = str(tmp_path / f"{name}.out")
+    marker = marker or str(tmp_path / f"{name}.ran")
+    return {
+        "name": name,
+        # the marker file counts executions so resume behavior is provable
+        "argv": [sys.executable, "-c",
+                 f"open({marker!r}, 'a').write('x')"],
+        "artifact": art,
+        "_marker": marker,
+    }
+
+
+def _runs(stage):
+    try:
+        with open(stage["_marker"]) as f:
+            return len(f.read())
+    except OSError:
+        return 0
+
+
+def test_harvest_runs_all_stages_and_writes_index(tmp_path, monkeypatch):
+    h = _load_harvest(tmp_path, monkeypatch)
+    stages = [_ok_stage("a", tmp_path), _ok_stage("b", tmp_path)]
+    ok = h.harvest(stages, cooldown_s=0,
+                   probe={"platform": "tpu", "kind": "fake"})
+    assert ok
+    index = json.loads((tmp_path / "HARVEST.json").read_text())
+    assert index["complete"] is True
+    assert index["backend"]["kind"] == "fake"
+    assert index["stages"]["a"]["status"] == "ok"
+    assert index["stages"]["b"]["status"] == "ok"
+    assert _runs(stages[0]) == 1 and _runs(stages[1]) == 1
+
+
+def test_harvest_resumes_skipping_completed_stages(tmp_path, monkeypatch):
+    h = _load_harvest(tmp_path, monkeypatch)
+    good = _ok_stage("good", tmp_path)
+    bad = {
+        "name": "bad",
+        "argv": [sys.executable, "-c", "import sys; sys.exit(1)"],
+        "artifact": str(tmp_path / "bad.out"),
+    }
+    ok = h.harvest([good, bad], cooldown_s=0)
+    assert not ok
+    index = json.loads((tmp_path / "HARVEST.json").read_text())
+    assert index["complete"] is False
+    assert index["stages"]["bad"]["status"] == "failed"
+
+    # second contact window: the completed stage must NOT re-run (single
+    # chip time is precious), the failed one must retry
+    fixed = dict(bad, argv=_ok_stage("bad2", tmp_path)["argv"],
+                 _marker=str(tmp_path / "bad2.ran"))
+    ok = h.harvest([good, fixed], cooldown_s=0)
+    assert ok
+    assert _runs(good) == 1, "completed stage re-ran on resume"
+    index = json.loads((tmp_path / "HARVEST.json").read_text())
+    assert index["complete"] is True
+
+
+def test_harvest_stage_timeout_is_bounded(tmp_path, monkeypatch):
+    h = _load_harvest(tmp_path, monkeypatch)
+    hang = {
+        "name": "hang",
+        "argv": [sys.executable, "-c", "import time; time.sleep(60)"],
+        "artifact": str(tmp_path / "hang.out"),
+    }
+    ok = h.harvest([hang], cooldown_s=0, stage_timeout_s=1.0)
+    assert not ok
+    index = json.loads((tmp_path / "HARVEST.json").read_text())
+    assert index["stages"]["hang"]["status"] == "timeout"
+
+
+def test_bench_stage_parses_json_and_fails_on_error_record(
+        tmp_path, monkeypatch):
+    h = _load_harvest(tmp_path, monkeypatch)
+    art = tmp_path / "bench.json"
+    # a bench error record (value null) must count as a FAILED stage so a
+    # later window retries the measurement, not a success with no number
+    err_stage = {
+        "name": "bench",
+        "argv": [sys.executable, "-c",
+                 "print('noise'); "
+                 "print('{\"metric\": \"m\", \"value\": null, "
+                 "\"error\": \"tunnel down\"}')"],
+        "artifact": str(art),
+        "capture_json": True,
+    }
+    assert not h.harvest([err_stage], cooldown_s=0)
+    assert json.loads(art.read_text())["error"] == "tunnel down"
+
+    good_stage = dict(err_stage, argv=[
+        sys.executable, "-c",
+        "print('{\"metric\": \"m\", \"value\": 0.5}')"])
+    assert h.harvest([good_stage], cooldown_s=0)
+    assert json.loads(art.read_text())["value"] == 0.5
+
+
+def test_optional_stage_with_missing_binary_is_skipped(tmp_path, monkeypatch):
+    h = _load_harvest(tmp_path, monkeypatch)
+    stage = {
+        "name": "native",
+        "argv": [str(tmp_path / "not_built"), "arg"],
+        "artifact": str(tmp_path / "native.out"),
+        "optional": True,
+    }
+    ok = h.harvest([stage], cooldown_s=0)
+    assert ok, "missing optional binary must not fail the harvest"
+    index = json.loads((tmp_path / "HARVEST.json").read_text())
+    assert index["stages"]["native"]["status"] == "skipped"
+
+
+def test_index_survives_torn_write(tmp_path, monkeypatch):
+    h = _load_harvest(tmp_path, monkeypatch)
+    (tmp_path / "HARVEST.json").write_text("{torn")
+    assert h.load_index() == {"stages": {}}
+
+
+def test_default_stage_table_shape():
+    """The real stage table must reference existing scripts and keep the
+    serialized order preflight -> bench -> profile -> pjrt_smoke."""
+    spec = importlib.util.spec_from_file_location(
+        "harvest", os.path.join(REPO, "tools", "harvest.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    stages = mod.default_stages()
+    names = [s["name"] for s in stages]
+    assert names == ["chip_preflight", "bench", "bench_profile", "pjrt_smoke"]
+    for s in stages:
+        # every non-optional stage's entry script must exist in-tree
+        if not s.get("optional"):
+            path = s["argv"][0 if not s["argv"][0].endswith("python")
+                             else 1]
+            assert os.path.exists(path), path
