@@ -195,6 +195,7 @@ def _block(
     mesh=None,
     attn_fn=None,  # override (e.g. manual sp attention inside the pipeline)
     tp_axis: Optional[str] = None,  # manual megatron-tp inside shard_map
+    ep_axis: Optional[str] = None,  # manual expert parallelism in shard_map
 ) -> Tuple[jax.Array, jax.Array]:
     """One pre-LN transformer block: x + attn(ln1(x)); x + mlp(ln2(x)).
 
@@ -263,7 +264,7 @@ def _block(
         m, aux = moe.moe_mlp(
             h2, blk["w_router"], blk["w_e1"], blk["w_e2"],
             top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
-            w_gate=blk.get("w_eg"),
+            w_gate=blk.get("w_eg"), ep_axis=ep_axis,
         )
     elif cfg.swiglu:
         if tp_axis is not None:
@@ -364,11 +365,14 @@ def forward(
             if t % sp:
                 raise ValueError(f"T={t} not divisible by sp={sp} under pp")
             # (ulysses head-divisibility is checked below, tp-aware)
-        if cfg.n_experts and mesh.shape.get("ep", 1) > 1:
-            raise NotImplementedError(
-                "expert (ep) sharding inside pipeline stages is not "
-                "supported: stage entry gathers each stage's params, so use "
-                "ep=1 with pp>1 (experts replicate) or pp=1 with ep>1"
+        # ep x pp (VERDICT r3 next #6): expert leaves (w_e*) keep their ep
+        # sharding through xs_specs; the MoE runs manual expert parallelism
+        # inside the region (two all_to_alls over ep — ops/moe.py ep_axis)
+        ep_n = mesh.shape.get("ep", 1)
+        ep_manual = bool(cfg.n_experts) and ep_n > 1
+        if ep_manual and cfg.n_experts % ep_n:
+            raise ValueError(
+                f"n_experts={cfg.n_experts} not divisible by ep={ep_n}"
             )
         manual_attn = _manual_sp_attention(cfg) if seq_sharded else None
 
@@ -455,7 +459,8 @@ def forward(
                 blk = gather_fsdp(blk)
                 y, a = _block(xc, blk, cfg, rope_c, key, deterministic,
                               attn_fn=manual_attn,
-                              tp_axis="tp" if tp_manual else None)
+                              tp_axis="tp" if tp_manual else None,
+                              ep_axis="ep" if ep_manual else None)
                 return (y, aux + a)
 
             if deterministic:

@@ -93,18 +93,38 @@ def _route_group(probs, *, top_k: int, cap: int):
 def moe_mlp(
     x: jax.Array,        # (B, T, D) — post-norm activations
     w_router: jax.Array,  # (D, E)
-    w_e1: jax.Array,      # (E, D, F)
+    w_e1: jax.Array,      # (E, D, F) — E/ep local experts under ep_axis
     w_e2: jax.Array,      # (E, F, D)
     *,
     top_k: int = 2,
     capacity_factor: float = 1.25,
     w_gate: jax.Array = None,  # (E, D, F): SwiGLU experts (Mixtral-style)
+    ep_axis: str = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Expert-routed MLP: GELU experts, or SwiGLU when ``w_gate`` is given
     (h = silu(x·w_gate) * (x·w_e1), Mixtral-style). Returns
-    (out (B, T, D), aux_loss scalar)."""
+    (out (B, T, D), aux_loss scalar).
+
+    ``ep_axis``: manual expert parallelism for shard_map regions (the
+    pipeline — models/gpt.py), where GSPMD can't insert the all-to-alls
+    itself. ``x`` is this shard's tokens, ``w_e*`` hold E/ep local experts
+    (expert dim sharded by PARAM_RULES), ``w_router`` is replicated with
+    all E columns. Routing runs locally against ALL experts; the expert
+    FFN is redistributed with two all_to_alls over ``ep_axis`` — the same
+    exchange GSPMD derives for the sharded einsum in the non-manual path.
+    The aux loss stays a per-shard statistic either way; callers average
+    it over the batch-ish axes (pipeline.py pmean includes ep).
+    """
     b, t, d = x.shape
     e = w_e1.shape[0]
+    ep = 1
+    if ep_axis is not None:
+        ep = jax.lax.psum(1, ep_axis)
+        e = e * ep  # e: GLOBAL expert count; w_e* hold e/ep local rows
+    if w_router.shape[1] != e:
+        raise ValueError(
+            f"router has {w_router.shape[1]} experts, weights imply {e}"
+        )
     s = b * t
     gs = _group_size(s)
     ng = s // gs
@@ -123,6 +143,14 @@ def moe_mlp(
     # (G, gs, E, cap) x (G, gs, D) -> experts see (E, G*cap, D)
     expert_in = jnp.einsum("gsec,gsd->gecd", dispatch.astype(x.dtype), xs)
     expert_in = expert_in.transpose(1, 0, 2, 3).reshape(e, ng * cap, d)
+    if ep_axis is not None:
+        # exchange: every shard sends each peer the inputs it routed to
+        # that peer's experts, receiving its own experts' tokens from all
+        # peers -> (E/ep, ep*n, d); shard i holds global experts
+        # [i*E/ep, (i+1)*E/ep) exactly as PARAM_RULES lays them out
+        expert_in = jax.lax.all_to_all(
+            expert_in, ep_axis, split_axis=0, concat_axis=1, tiled=True
+        )
     up = jnp.einsum(
         "end,edf->enf", expert_in, w_e1.astype(x.dtype),
         preferred_element_type=jnp.float32,
@@ -138,7 +166,13 @@ def moe_mlp(
     expert_out = jnp.einsum(
         "enf,efd->end", h, w_e2.astype(x.dtype),
         preferred_element_type=jnp.float32,
-    )  # (E, G*cap, D) fp32
+    )  # (E, G*cap, D) fp32 — (E/ep, ep*n, D) under ep_axis
+    if ep_axis is not None:
+        # inverse exchange: outputs return to the shards whose tokens they
+        # are -> (E, n, d) with the global expert axis restored
+        expert_out = jax.lax.all_to_all(
+            expert_out, ep_axis, split_axis=1, concat_axis=0, tiled=True
+        )
     expert_out = expert_out.reshape(e, ng, cap, d).transpose(1, 0, 2, 3)
     out = jnp.einsum(
         "gsec,gecd->gsd", combine.astype(jnp.float32), expert_out

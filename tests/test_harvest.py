@@ -156,6 +156,6 @@ def test_default_stage_table_shape():
     for s in stages:
         # every non-optional stage's entry script must exist in-tree
         if not s.get("optional"):
-            path = s["argv"][0 if not s["argv"][0].endswith("python")
-                             else 1]
+            base = os.path.basename(s["argv"][0])
+            path = s["argv"][1] if base.startswith("python") else s["argv"][0]
             assert os.path.exists(path), path

@@ -214,13 +214,88 @@ def test_pp_with_moe_matches_no_pp(eight_devices):
     np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-4)
 
 
-def test_pp_with_ep_refused(eight_devices):
-    cfg, params, tokens = cfg_and_inputs(n_experts=2)
+def test_pp_with_ep_matches_no_pp(eight_devices):
+    """pp=2 x ep=2 (VERDICT r3 next #6): experts stay SHARDED inside the
+    pipeline region (xs_specs keeps the ep axis on w_e* leaves) and the
+    MoE runs manual expert parallelism (two all_to_alls, ops/moe.py
+    ep_axis) — the loss must match the dense no-mesh model. Generous
+    capacity so routing is grouping-invariant, as in the ep=1 pp test."""
+    cfg, params, tokens = cfg_and_inputs(
+        n_experts=2, moe_top_k=1, moe_capacity_factor=4.0
+    )
+    _, want_loss = gpt.forward(params, tokens, cfg, targets=tokens)
     mesh = mesh_lib.make_mesh(
         MeshConfig(pp=2, dp=2, fsdp=1, tp=1, sp=1, ep=2),
         devices=eight_devices,
     )
-    with pytest.raises(NotImplementedError, match="ep"):
+    _, got_loss = jax.jit(
+        lambda p, t: gpt.forward(p, t, cfg, targets=t, mesh=mesh)
+    )(params, tokens)
+    np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-4)
+
+
+def test_pp_with_ep_keeps_experts_sharded_in_region(eight_devices,
+                                                    monkeypatch):
+    """The in-region sharding assert: inside the pp x ep region each shard
+    must hold E/ep experts (w_e1 leading dim), not gathered copies —
+    captured from the moe_mlp call the pipeline's stage body makes."""
+    from mingpt_distributed_tpu.ops import moe as moe_mod
+
+    seen = []
+    real = moe_mod.moe_mlp
+
+    def capture(x, w_router, w_e1, w_e2, **kw):
+        seen.append({"w_e1": tuple(w_e1.shape),
+                     "router_e": w_router.shape[1],
+                     "ep_axis": kw.get("ep_axis")})
+        return real(x, w_router, w_e1, w_e2, **kw)
+
+    monkeypatch.setattr(moe_mod, "moe_mlp", capture)
+    cfg, params, tokens = cfg_and_inputs(
+        n_experts=2, moe_top_k=1, moe_capacity_factor=4.0
+    )
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=1, sp=1, ep=2),
+        devices=eight_devices,
+    )
+    gpt.forward(params, tokens, cfg, targets=tokens, mesh=mesh)
+    assert seen, "moe_mlp never called inside the pipeline"
+    for rec in seen:
+        assert rec["ep_axis"] == "ep"
+        assert rec["w_e1"][0] == 1, rec  # E/ep = 2/2 local experts
+        assert rec["router_e"] == 2, rec  # router sees ALL experts
+
+
+def test_pp_ep_gradients_match_dense(eight_devices):
+    """Gradients through the manual-ep MoE inside pipeline stages (a2a
+    transpose + router gradient + aux) must match the dense model."""
+    cfg, params, tokens = cfg_and_inputs(
+        n_experts=2, moe_top_k=1, moe_capacity_factor=4.0
+    )
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=1, sp=1, ep=2),
+        devices=eight_devices,
+    )
+
+    def loss_fn(p, m):
+        return gpt.forward(p, tokens, cfg, targets=tokens, mesh=m)[1]
+
+    g_want = jax.grad(lambda p: loss_fn(p, None))(params)
+    g_got = jax.jit(jax.grad(lambda p: loss_fn(p, mesh)))(params)
+    for a, b in zip(jax.tree.leaves(g_got), jax.tree.leaves(g_want)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_pp_ep_indivisible_experts_refused(eight_devices):
+    cfg, params, tokens = cfg_and_inputs(
+        n_experts=3, moe_top_k=1, moe_capacity_factor=4.0
+    )
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=2, dp=2, fsdp=1, tp=1, sp=1, ep=2),
+        devices=eight_devices,
+    )
+    with pytest.raises(ValueError, match="not divisible by ep"):
         gpt.forward(params, tokens, cfg, targets=tokens, mesh=mesh)
 
 

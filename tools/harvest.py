@@ -89,7 +89,8 @@ def default_stages() -> list[dict]:
 def probe_backend(timeout_s: float = PROBE_TIMEOUT_S) -> dict:
     """Same bounded-subprocess probe bench.py uses (never imports jax in
     this process — a hung tunnel must not hang the harvester)."""
-    sys.path.insert(0, REPO)
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
     import bench
 
     old = bench.PROBE_TIMEOUT_S
@@ -194,12 +195,14 @@ def harvest(stages: list[dict] | None = None, *,
     if probe:
         index["backend"] = probe
     all_ok = True
-    for i, stage in enumerate(stages):
+    ran_one = False
+    for stage in stages:
         prior = index["stages"].get(stage["name"])
         if prior and prior.get("status") in ("ok", "skipped"):
             continue  # resume: completed stages are not re-run
-        if i > 0 and cooldown_s:
+        if ran_one and cooldown_s:
             time.sleep(cooldown_s)  # let the chip lease settle
+        ran_one = True
         print(f"harvest: running {stage['name']}", flush=True)
         rec = run_stage(stage, stage_timeout_s)
         index["stages"][stage["name"]] = rec
