@@ -219,10 +219,14 @@ def profile_inner(outdir: str) -> int:
     seq = int(os.environ.get("BENCH_SEQ", "1024"))
     batch = int(os.environ.get("BENCH_PROFILE_BATCH", "16"))
     attention = os.environ.get("BENCH_PROFILE_ATTENTION", "flash")
+    # default to the round-4 winning step config (unrolled layer loop)
+    unroll_layers = os.environ.get("BENCH_PROFILE_UNROLL", "1") == "1"
+    remat = os.environ.get("BENCH_PROFILE_REMAT", "0") == "1"
     cfg = GPTConfig.make(
         model_type=model,
         embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
         dtype="bfloat16", attention=attention,
+        unroll_layers=unroll_layers, remat=remat,
         block_size=max(seq, 1024),
     )
     optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
@@ -251,6 +255,7 @@ def profile_inner(outdir: str) -> int:
     print(json.dumps({
         "profile_dir": outdir, "batch": batch, "seq": seq,
         "attention": attention, "steps": n,
+        "unroll_layers": unroll_layers, "remat": remat,
         "steps_per_sec": round(n / dt, 3), "loss": loss,
         "device": jax.devices()[0].device_kind,
     }))
@@ -370,7 +375,8 @@ def inner() -> int:
 
     def bench_attention(
         attention: str, batches=default_batches, scan_unroll: int = 1,
-        remat: bool = False,
+        remat: bool = False, unroll_layers: bool = False,
+        loss_chunks: int = 8,
     ) -> tuple[int, float] | None:
         """(batch, steps/sec) at the largest batch that fits, else None."""
         cfg = GPTConfig.make(
@@ -380,6 +386,8 @@ def inner() -> int:
             attention=attention,
             scan_unroll=scan_unroll,
             remat=remat,
+            unroll_layers=unroll_layers,
+            loss_chunks=loss_chunks,
             block_size=max(seq, 1024),
         )
         optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
@@ -434,35 +442,52 @@ def inner() -> int:
     results: dict[str, tuple[int, float]] = {}
     unrolls: dict[str, int] = {}
     remats: dict[str, bool] = {}
+    layer_unrolls: dict[str, bool] = {}
+    ce_chunks: dict[str, int] = {}  # loss_chunks per path (reproducibility)
+    # config ladder per attention path, best-first (round-4 on-chip
+    # evidence): the unrolled layer loop removes the scan's
+    # dynamic-update-slice activation stacking — ~23% of step time on the
+    # r4 trace AND the allocation that made batch >= 16 fail to compile —
+    # so it both wins on speed (MFU 0.33 -> 0.43) and unlocks larger
+    # batches. Scan + remat remains the memory-floor fallback.
+    config_ladder = (
+        {"unroll_layers": True, "remat": False},
+        {"unroll_layers": False, "remat": False},
+        {"unroll_layers": False, "remat": True},
+    )
     for attention in ("flash", "einsum"):
-        r = bench_attention(attention)
-        remats[attention] = False
-        if r is None:
-            # every batch failed (HBM): trade FLOPs for memory and retry —
-            # a remat-ed number beats a null record
-            r = bench_attention(attention, remat=True)
-            remats[attention] = True
+        r = None
+        for knobs in config_ladder:
+            r = bench_attention(attention, **knobs)
+            if r is not None:
+                remats[attention] = knobs["remat"]
+                layer_unrolls[attention] = knobs["unroll_layers"]
+                break
         if r is not None:
             results[attention] = r
             unrolls[attention] = 1
+            ce_chunks[attention] = 8
             print(
                 f"{attention}: batch={r[0]} steps/sec={r[1]:.3f}"
-                + (" (remat)" if remats[attention] else ""),
+                + (" (remat)" if remats[attention] else "")
+                + (" (unrolled)" if layer_unrolls[attention] else ""),
                 file=sys.stderr,
             )
 
     flash_block = None  # None = the kernel's default ladder choice
     if "flash" in results:
         # one bounded extra compile: layer-scan unroll at the winning batch
-        # (lets XLA fuse across layer boundaries); keep it if faster
+        # (lets XLA fuse across layer boundaries); only meaningful when the
+        # scan path won (the unrolled python loop has no scan to unroll)
         b_star, sps_star = results["flash"]
-        r = bench_attention("flash", batches=(b_star,), scan_unroll=4,
-                            remat=remats["flash"])
-        if r is not None and r[1] > sps_star:
-            results["flash"] = r
-            unrolls["flash"] = 4
-            print(f"flash unroll=4: steps/sec={r[1]:.3f} (kept)",
-                  file=sys.stderr)
+        if not layer_unrolls["flash"]:
+            r = bench_attention("flash", batches=(b_star,), scan_unroll=4,
+                                remat=remats["flash"])
+            if r is not None and r[1] > sps_star:
+                results["flash"] = r
+                unrolls["flash"] = 4
+                print(f"flash unroll=4: steps/sec={r[1]:.3f} (kept)",
+                      file=sys.stderr)
         # flash block-size sweep at the winning batch (VERDICT r2 weak #4:
         # the (512, 256, 128) ladder was never measured) — two bounded
         # extra compiles; keep the override only if it beats the default
@@ -472,6 +497,7 @@ def inner() -> int:
                 r = bench_attention(
                     "flash", batches=(results["flash"][0],),
                     scan_unroll=unrolls["flash"], remat=remats["flash"],
+                    unroll_layers=layer_unrolls["flash"],
                 )
             finally:
                 os.environ.pop("FLASH_BLOCK", None)
@@ -482,6 +508,19 @@ def inner() -> int:
                       file=sys.stderr)
         if flash_block is not None:
             os.environ["FLASH_BLOCK"] = str(flash_block)  # for extras below
+        # CE chunk-count probe (r4 on-chip: 4 beat 8 by ~1% at batch 16 with
+        # the unrolled chunk loop; larger counts lose matmul efficiency) —
+        # one bounded extra compile, kept only if faster
+        r = bench_attention(
+            "flash", batches=(results["flash"][0],),
+            scan_unroll=unrolls["flash"], remat=remats["flash"],
+            unroll_layers=layer_unrolls["flash"], loss_chunks=4,
+        )
+        if r is not None and r[1] > results["flash"][1]:
+            results["flash"] = r
+            ce_chunks["flash"] = 4
+            print(f"flash loss_chunks=4: steps/sec={r[1]:.3f} (kept)",
+                  file=sys.stderr)
 
     if not results:
         print(json.dumps(_error_record("all attention paths failed or OOMed")))
@@ -519,6 +558,8 @@ def inner() -> int:
             "mfu": round(mfu, 4) if mfu is not None else None,
             "scan_unroll": unrolls.get(attention, 1),
             "remat": remats.get(attention, False),
+            "unroll_layers": layer_unrolls.get(attention, False),
+            "loss_chunks": ce_chunks.get(attention, 8),
         }
     if not results:
         print(json.dumps(_error_record(
@@ -544,6 +585,8 @@ def inner() -> int:
             "vs_baseline": round(mfu / 0.80, 4) if mfu is not None else None,
             "attention": best,
             "scan_unroll": unrolls.get(best, 1),
+            "unroll_layers": layer_unrolls.get(best, False),
+            "loss_chunks": ce_chunks.get(best, 8),
             "flash_block": flash_block,  # None = default ladder
             "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
             "flops_per_token": fpt,
@@ -586,16 +629,25 @@ def inner() -> int:
             out = fa.flash_with_lse(q, k, v, 1.0 / _math.sqrt(hd), 512, True)[0]
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
+        def timed_min(gfn, n=5, repeats=3):
+            """Best-of-repeats timing: independent dispatches through the
+            tunnel relay don't pipeline, so single windows are noisy (r4:
+            2.01x and 0.76x window_speedup on identical code the same
+            day); the min over repeated windows is the stable estimator."""
+            for _ in range(2):
+                r = gfn(q, k, v)
+            float(jax.device_get(r[0][0, 0, 0]))
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    r = gfn(q, k, v)
+                float(jax.device_get(r[0][0, 0, 0]))
+                best = min(best, (time.perf_counter() - t0) / n)
+            return best
+
         g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
-        for _ in range(2):
-            r = g(q, k, v)
-        float(jax.device_get(r[0][0, 0, 0]))
-        n = 5
-        t0 = time.perf_counter()
-        for _ in range(n):
-            r = g(q, k, v)
-        float(jax.device_get(r[0][0, 0, 0]))
-        dt = (time.perf_counter() - t0) / n
+        dt = timed_min(g)
         # causal fwd 2 matmuls: 4*bh*T^2*hd/2 flops; bwd ~2.5x more
         flops = 3.5 * 4 * bh * t_lc * t_lc * hd / 2
         if peak and flops / dt > 1.2 * peak:
@@ -618,14 +670,7 @@ def inner() -> int:
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
         gw = jax.jit(jax.grad(attn_loss_win, argnums=(0, 1, 2)))
-        for _ in range(2):
-            r = gw(q, k, v)
-        float(jax.device_get(r[0][0, 0, 0]))
-        t0 = time.perf_counter()
-        for _ in range(n):
-            r = gw(q, k, v)
-        float(jax.device_get(r[0][0, 0, 0]))
-        dt_w = (time.perf_counter() - t0) / n
+        dt_w = timed_min(gw)
         # banded rows attend ~window keys vs the causal average T/2, so
         # banded work ~= full * 2*win/T; same 1.2x-peak refusal applies
         flops_w = flops * 2 * win / t_lc

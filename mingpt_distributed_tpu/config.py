@@ -177,6 +177,15 @@ class GPTConfig:
     # non-dividing remainder): >1 lets XLA fuse across layer boundaries at
     # the cost of compile time.
     scan_unroll: int = 1
+    # Replace the layer lax.scan with a statically unrolled python loop.
+    # The scan stacks every saved-for-backward activation into (n_layer,
+    # ...) buffers via dynamic-update-slice — ~23% of step time on the
+    # round-4 TPU trace (bitcast_dynamic-update-slice fusions). Unrolled,
+    # XLA plans each layer's residuals as individual statically-addressed
+    # buffers: no stacking copies, better fusion across the layer
+    # boundary, at the cost of an n_layer-times-larger HLO (slower
+    # compile). Ignored under pp (the pipeline has its own schedule).
+    unroll_layers: bool = False
 
     @classmethod
     def make(cls, **kwargs: Any) -> "GPTConfig":

@@ -93,6 +93,40 @@ def make_mesh(
     return Mesh(arr, AXES)
 
 
+def dropped_attention_shard_map(shard, mesh: Mesh, spec: P, pdrop: float,
+                                head_axis: Optional[str] = None):
+    """shard_map wrapper for sequence-parallel attention bodies under
+    attention dropout (single-sourced decorrelation policy — used by both
+    ring_attention and ulysses public wrappers).
+
+    The dropout key rides in replicated (P()); each shard folds in
+
+      - its batch-shard coordinate over ``BATCH_AXES`` — the dense GSPMD
+        path draws masks per *global* row, so dp/fsdp/ep shards holding
+        different rows must draw different masks;
+      - its ``head_axis`` coordinate, ONLY when the q/k/v specs actually
+        shard heads over that axis — tp shards then hold different global
+        heads and must draw per-head-independent masks (mirroring the
+        k_attn fold in models/gpt._block's manual-tp branch). When heads
+        are *replicated* over tp (head_axis=None) every replica must draw
+        the SAME mask or the replicas would diverge.
+
+    The shard body then folds finer-grained ids (the ring's global
+    (q-chunk, k-chunk) pair id; ulysses' head-group index) on top.
+    """
+
+    def dropped(q, k, v, key):
+        key = jax.random.fold_in(key, jax.lax.axis_index(BATCH_AXES))
+        if head_axis is not None:
+            key = jax.random.fold_in(key, jax.lax.axis_index(head_axis))
+        return shard(q, k, v, pdrop=pdrop, key=key)
+
+    return jax.shard_map(
+        dropped, mesh=mesh, in_specs=(spec, spec, spec, P()),
+        out_specs=spec, check_vma=False,
+    )
+
+
 def batch_spec() -> P:
     """(batch, seq) inputs: batch over dp+fsdp, seq over sp."""
     return P(BATCH_AXES, "sp")

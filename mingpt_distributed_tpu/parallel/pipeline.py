@@ -37,6 +37,15 @@ Two schedules (``schedule=`` / cfg.pp_schedule):
   stashes dominate HBM and the ~25% step-FLOP tax is worth the headroom.
   Same bubble fraction either way.
 
+  Measured (XLA memory_analysis/cost_analysis on the compiled pp=2, M=8
+  tiny-GPT train step — test_pipeline.py::test_pp_schedule_cost_model_is_
+  measured keeps the ordering pinned): gpipe no-remat 14.2 MB temp /
+  49 GFLOP; gpipe+remat 1.7 MB / 54 GFLOP (+10%); 1f1b 3.1 MB / 63 GFLOP
+  (+29%). So gpipe+remat is the default memory-saver; 1f1b's niche is
+  avoiding remat's recompute *latency* inside each tick (its re-forward
+  overlaps the pipeline) or models where jax.checkpoint granularity is
+  too coarse.
+
 Composition:
 - pp x dp/fsdp: batch stays sharded over BATCH_AXES inside the region.
 - pp x sp (``seq_sharded=True``): activations stay sequence-sharded inside

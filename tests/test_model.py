@@ -167,6 +167,33 @@ def test_chunked_cross_entropy_matches_dense():
         np.testing.assert_allclose(a, b, atol=1e-6)
 
 
+def test_chunked_cross_entropy_unrolled_matches_dense():
+    """The unrolled chunk loop (cfg.unroll_layers threads into
+    chunked_cross_entropy) must match the dense head exactly, loss and
+    grads, including ignore_index handling."""
+    import dataclasses
+
+    cfg_d = dataclasses.replace(small_cfg(), loss_chunks=0)
+    cfg_u = dataclasses.replace(small_cfg(), loss_chunks=4,
+                                unroll_layers=True)
+    params = gpt.init(jax.random.key(0), cfg_d)
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 65)
+    tgt = tokens.at[0, :3].set(-1)
+
+    _, l_d = gpt.forward(params, tokens, cfg_d, targets=tgt)
+    _, l_u = gpt.forward(params, tokens, cfg_u, targets=tgt,
+                         return_logits=False)
+    assert abs(float(l_d) - float(l_u)) < 1e-6
+
+    g_d = jax.grad(lambda p: gpt.forward(p, tokens, cfg_d, targets=tgt)[1])(params)
+    g_u = jax.grad(
+        lambda p: gpt.forward(p, tokens, cfg_u, targets=tgt,
+                              return_logits=False)[1]
+    )(params)
+    for a, b in zip(jax.tree.leaves(g_d), jax.tree.leaves(g_u)):
+        np.testing.assert_allclose(a, b, atol=1e-6)
+
+
 def test_chunked_cross_entropy_indivisible_t_snaps_to_divisor():
     """loss_chunks=7 with T=16 snaps to 4 chunks (largest divisor <= 7) —
     never silently dense — and the loss is unchanged; a prime T (no
@@ -205,3 +232,55 @@ def test_loss_only_mode_returns_no_logits():
     logits_d, loss_d = gpt.forward(params, tokens, cfg, targets=tokens)
     assert logits_d.shape == (2, 16, 65)
     assert abs(float(loss) - float(loss_d)) < 1e-6
+
+
+def test_unroll_layers_matches_scan():
+    """cfg.unroll_layers replaces the layer lax.scan with a static python
+    loop (round-4 perf: removes the scan's DUS activation stacking) — it
+    must be semantically invisible: same logits, same loss, same grads."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import gpt
+
+    base = dict(
+        n_layer=3, n_head=2, n_embd=32, vocab_size=64, block_size=16,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    cfg_scan = GPTConfig.make(**base)
+    cfg_unroll = GPTConfig.make(**base, unroll_layers=True)
+    params = gpt.init(jax.random.key(0), cfg_scan)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 64)
+
+    logits_a, loss_a = gpt.forward(params, tokens, cfg_scan, targets=tokens)
+    logits_b, loss_b = gpt.forward(params, tokens, cfg_unroll,
+                                   targets=tokens)
+    np.testing.assert_allclose(np.asarray(logits_b), np.asarray(logits_a),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(loss_b), float(loss_a), rtol=1e-6)
+
+    g_a = jax.grad(lambda p: gpt.forward(p, tokens, cfg_scan,
+                                         targets=tokens)[1])(params)
+    g_b = jax.grad(lambda p: gpt.forward(p, tokens, cfg_unroll,
+                                         targets=tokens)[1])(params)
+    for (pa, a), b in zip(
+        jax.tree_util.tree_leaves_with_path(g_a), jax.tree.leaves(g_b)
+    ):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=1e-4, atol=1e-5,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(pa)}",
+        )
+
+    # dropout path: keys are split identically, so training-mode forward
+    # with the same rng must match exactly as well
+    cfg_s2 = GPTConfig.make(**{**base, "resid_pdrop": 0.3})
+    cfg_u2 = GPTConfig.make(**{**base, "resid_pdrop": 0.3},
+                            unroll_layers=True)
+    la, _ = gpt.forward(params, tokens, cfg_s2, rng=jax.random.key(5),
+                        deterministic=False)
+    lb, _ = gpt.forward(params, tokens, cfg_u2, rng=jax.random.key(5),
+                        deterministic=False)
+    np.testing.assert_allclose(np.asarray(lb), np.asarray(la),
+                               rtol=1e-5, atol=1e-5)

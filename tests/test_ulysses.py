@@ -92,3 +92,45 @@ def test_train_step_ulysses_matches_dp(tmp_path, eight_devices):
     finally:
         tt.tiny_gpt_cfg = orig
     np.testing.assert_allclose(l_dp, l_ul, rtol=2e-4, atol=2e-4)
+
+
+# --- attention dropout composes with ulysses (VERDICT r3 weak #4) ---------
+
+
+def test_ulysses_dropout_matches_headgroup_oracle(eight_devices):
+    """Dropped ulysses output == per-head-group dense oracle with the same
+    folded keys: the wrapper folds the batch-shard coordinate (0 at dp=1),
+    the shard folds its head-group index, and the local call IS the dense
+    oracle over the full sequence for that head group."""
+    sp = 4
+    mesh = sp_mesh(dp=1, sp=sp)
+    q, k, v = qkv(b=2, t=32, h=4, hd=8, seed=7)
+    key = jax.random.key(11)
+    key0 = jax.random.fold_in(key, 0)  # batch-shard coordinate at dp=1
+    hg = q.shape[2] // sp
+    outs = []
+    for g in range(sp):
+        sl = slice(g * hg, (g + 1) * hg)
+        outs.append(attn_ops.causal_attention(
+            q[:, :, sl], k[:, :, sl], v[:, :, sl],
+            attn_pdrop=0.5, dropout_key=jax.random.fold_in(key0, g),
+            deterministic=False,
+        ))
+    want = jnp.concatenate(outs, axis=2)
+    got = jax.jit(lambda *a: ulysses_causal_attention(
+        *a, mesh, attn_pdrop=0.5, dropout_key=key, deterministic=False
+    ))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_dropout_deterministic_and_keyed(eight_devices):
+    mesh = sp_mesh(dp=2, sp=4)
+    q, k, v = qkv(seed=13)
+    run = jax.jit(lambda key: ulysses_causal_attention(
+        q, k, v, mesh, attn_pdrop=0.3, dropout_key=key, deterministic=False
+    ))
+    a, b2 = run(jax.random.key(1)), run(jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b2))
+    c = run(jax.random.key(2))
+    assert not np.allclose(np.asarray(a), np.asarray(c), atol=1e-6)

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""On-chip step-time decomposition (round-4 trace follow-up): forward vs
+backward vs optimizer vs CE-head share of the train step, plus loss_chunks
+and scan_unroll sensitivity, at the bench config (gpt2-124M, seq 1024)."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
+from mingpt_distributed_tpu.models import gpt
+from mingpt_distributed_tpu.training.optimizer import make_optimizer
+from mingpt_distributed_tpu.training.trainer import make_train_step
+
+SEQ = 1024
+
+
+def mk(batch, **kw):
+    base = dict(
+        model_type="gpt2",
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="bfloat16", attention="flash", block_size=SEQ,
+    )
+    base.update(kw)
+    cfg = GPTConfig.make(**base)
+    params = jax.jit(lambda k: gpt.init(k, cfg))(jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, SEQ), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    return cfg, params, tokens
+
+
+def timeit(fn, sync, n=20, warm=3):
+    for _ in range(warm):
+        out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) / n * 1e3  # ms/iter
+
+
+def main():
+    batch = int(os.environ.get("EXP_BATCH", "8"))
+    remat = os.environ.get("EXP_REMAT", "0") == "1"
+    cfg, params, tokens = mk(batch, remat=remat)
+
+    def loss_fn(p):
+        return gpt.forward(p, tokens, cfg, targets=tokens, mesh=None,
+                           return_logits=False)[1]
+
+    # 1. forward only (loss, chunked CE)
+    f = jax.jit(loss_fn)
+    ms_fwd = timeit(lambda: f(params), lambda o: float(jax.device_get(o)))
+    print(json.dumps({"what": "fwd_loss", "batch": batch, "remat": remat,
+                      "ms": round(ms_fwd, 2)}), flush=True)
+
+    # 2. forward + backward
+    g = jax.jit(jax.value_and_grad(loss_fn))
+    ms_fb = timeit(lambda: g(params),
+                   lambda o: float(jax.device_get(o[0])))
+    print(json.dumps({"what": "fwd_bwd", "ms": round(ms_fb, 2)}), flush=True)
+
+    # 3. full train step (adds optimizer + metrics)
+    optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
+    step_fn = jax.jit(make_train_step(cfg, optimizer), donate_argnums=(0,))
+    state = jax.jit(lambda p: {
+        "params": p, "opt_state": optimizer.init(p),
+        "step": jnp.asarray(0, dtype=jnp.int32),
+    })(params)
+    holder = {"s": state}
+
+    def stepper():
+        holder["s"], m = step_fn(holder["s"], (tokens, tokens),
+                                 jax.random.key(2))
+        return m
+
+    ms_step = timeit(stepper, lambda m: float(jax.device_get(m["loss"])))
+    print(json.dumps({"what": "train_step", "ms": round(ms_step, 2)}),
+          flush=True)
+
+    # 4. trunk only: forward WITHOUT the CE head (logits path short-circuit):
+    # time the blocks+embedding by returning the final hidden state norm.
+    # Approximate via loss with loss_chunks=1 vs 8 to price chunking policy.
+    for nc in (1, 2, 4, 16, 32):
+        cfg2, _, _ = mk(batch, remat=remat, loss_chunks=nc)
+        f2 = jax.jit(lambda p: gpt.forward(p, tokens, cfg2, targets=tokens,
+                                           return_logits=False)[1])
+        g2 = jax.jit(jax.value_and_grad(
+            lambda p: gpt.forward(p, tokens, cfg2, targets=tokens,
+                                  return_logits=False)[1]))
+        try:
+            ms2 = timeit(lambda: f2(params), lambda o: float(jax.device_get(o)))
+            ms2b = timeit(lambda: g2(params),
+                          lambda o: float(jax.device_get(o[0])))
+            print(json.dumps({"what": f"loss_chunks={nc}",
+                              "fwd_ms": round(ms2, 2),
+                              "fwd_bwd_ms": round(ms2b, 2)}), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"what": f"loss_chunks={nc}",
+                              "error": str(e).splitlines()[0][:160]}),
+                  flush=True)
+
+    # 5. scan_unroll sensitivity at the full step
+    for u in (2, 4):
+        cfg3, _, _ = mk(batch, remat=remat, scan_unroll=u)
+        step3 = jax.jit(make_train_step(cfg3, optimizer), donate_argnums=(0,))
+        st3 = jax.jit(lambda p: {
+            "params": p, "opt_state": optimizer.init(p),
+            "step": jnp.asarray(0, dtype=jnp.int32),
+        })(params)
+        h3 = {"s": st3}
+
+        def step3er():
+            h3["s"], m = step3(h3["s"], (tokens, tokens), jax.random.key(2))
+            return m
+
+        try:
+            ms3 = timeit(step3er, lambda m: float(jax.device_get(m["loss"])))
+            print(json.dumps({"what": f"train_step unroll={u}",
+                              "ms": round(ms3, 2)}), flush=True)
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"what": f"train_step unroll={u}",
+                              "error": str(e).splitlines()[0][:160]}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+#!/usr/bin/env python
+"""On-chip A/B: unroll_layers (no scan-stash DUS) x remat x batch at the
+bench config. Round-4 trace: DUS stacking = ~23% of step; this measures
+the end-to-end effect."""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
+from mingpt_distributed_tpu.models import gpt
+from mingpt_distributed_tpu.training.optimizer import make_optimizer
+from mingpt_distributed_tpu.training.trainer import make_train_step
+
+SEQ = 1024
+PEAK_TFLOPS = 197.0
+FLOPS_TOK = 854438400
+
+
+def run(batch, remat, unroll_layers):
+    cfg = GPTConfig.make(
+        model_type="gpt2",
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="bfloat16", attention="flash", remat=remat,
+        unroll_layers=unroll_layers, block_size=SEQ,
+    )
+    optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
+    step_fn = jax.jit(make_train_step(cfg, optimizer), donate_argnums=(0,))
+    state = jax.jit(
+        lambda k: {
+            "params": gpt.init(k, cfg),
+            "opt_state": optimizer.init(gpt.init(k, cfg)),
+            "step": jnp.asarray(0, dtype=jnp.int32),
+        }
+    )(jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, SEQ), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    rng = jax.random.key(2)
+    for _ in range(3):
+        state, m = step_fn(state, (tokens, tokens), rng)
+    float(jax.device_get(m["loss"]))
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, m = step_fn(state, (tokens, tokens), rng)
+    loss = float(jax.device_get(m["loss"]))
+    dt = time.perf_counter() - t0
+    assert loss == loss
+    sps = n / dt
+    tps = sps * batch * SEQ
+    return {"batch": batch, "remat": remat, "unroll_layers": unroll_layers,
+            "steps_per_sec": round(sps, 3), "tok_per_sec": round(tps, 1),
+            "mfu": round(tps * FLOPS_TOK / (PEAK_TFLOPS * 1e12), 4)}
+
+
+if __name__ == "__main__":
+    combos = [
+        (8, False, True),
+        (16, False, True),
+        (32, False, True),
+        (16, True, True),
+        (32, True, True),
+        (64, True, True),
+    ]
+    for b, r, u in combos:
+        try:
+            print(json.dumps(run(b, r, u)), flush=True)
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).splitlines()[0] if str(e) else type(e).__name__
+            print(json.dumps({"batch": b, "remat": r, "unroll_layers": u,
+                              "error": msg[:200]}), flush=True)
