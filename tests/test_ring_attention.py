@@ -349,3 +349,45 @@ def test_ring_dropout_decorrelated_across_tp_heads(eight_devices):
         *a, mesh, deterministic=True))(q2, k2, v2))
     np.testing.assert_allclose(det[:, :, 0], det[:, :, 1],
                                rtol=1e-6, atol=1e-6)
+
+
+def test_ring_dropout_composes_with_window_and_softcap(eight_devices):
+    """window + softcap + dropout all at once ride the einsum ring (the
+    dropped path): must equal the dense reference computed with the same
+    banded/capped scores and the identical blockwise masks."""
+    sp, t, w, cap = 4, 32, 9, 7.0
+    mesh = sp_mesh(dp=1, sp=sp)
+    q, k, v = qkv(b=2, t=t, h=2, hd=8, seed=37)
+    key = jax.random.key(41)
+
+    # dense reference with banded+capped scores and the ring's mask scheme
+    key0 = jax.random.fold_in(key, 0)
+    b, _, h, hd = q.shape
+    c = t // sp
+    scale = 1.0 / np.sqrt(hd)
+    logits = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    logits = cap * jnp.tanh(logits / cap)
+    qp = jnp.arange(t)[:, None]
+    kp = jnp.arange(t)[None, :]
+    allowed = (qp >= kp) & (qp - kp < w)
+    logits = jnp.where(allowed[None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    keep = 0.5
+    rows = []
+    for i in range(sp):
+        cols = []
+        for j in range(sp):
+            kij = jax.random.fold_in(key0, i * sp + j)
+            cols.append(jax.random.bernoulli(kij, keep, (b, h, c, c)))
+        rows.append(jnp.concatenate(cols, axis=-1))
+    mask = jnp.concatenate(rows, axis=-2)
+    probs = jnp.where(mask, probs / keep, 0.0)
+    want = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+
+    got = jax.jit(lambda *a: ring_causal_attention(
+        *a, mesh, attn_pdrop=0.5, dropout_key=key, deterministic=False,
+        window=w, logit_softcap=cap,
+    ))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
