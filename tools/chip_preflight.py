@@ -97,6 +97,34 @@ def main() -> int:
             ))) / scale
             check(f"flash_bwd_{name} t={t} hd={hd}", gerr)
 
+    # unrolled layer/CE loops (the r4 default fast path, config.unroll_layers)
+    # vs the scan path: one compiled train-forward each on a tiny model —
+    # the loss must agree, so a Mosaic/XLA regression in either loop shape
+    # is caught at the next contact window
+    try:
+        from mingpt_distributed_tpu.config import GPTConfig
+        from mingpt_distributed_tpu.models import gpt as gpt_mod
+
+        base = dict(n_layer=2, n_head=4, n_embd=128, vocab_size=512,
+                    block_size=256, embd_pdrop=0.0, resid_pdrop=0.0,
+                    attn_pdrop=0.0, dtype="bfloat16", attention="flash")
+        cfg_s = GPTConfig.make(**base)
+        cfg_u = GPTConfig.make(**base, unroll_layers=True)
+        p0 = jax.jit(lambda k2: gpt_mod.init(k2, cfg_s))(jax.random.key(11))
+        tk = jax.random.randint(jax.random.key(12), (4, 256), 0, 512,
+                                dtype=jnp.int32)
+        _, ls = jax.jit(lambda p, t2: gpt_mod.forward(
+            p, t2, cfg_s, targets=t2, return_logits=False))(p0, tk)
+        _, lu = jax.jit(lambda p, t2: gpt_mod.forward(
+            p, t2, cfg_u, targets=t2, return_logits=False))(p0, tk)
+        rel = abs(float(ls) - float(lu)) / max(abs(float(ls)), 1e-9)
+        check("unroll_vs_scan loss parity", rel, 1e-2)
+    except Exception as e:  # noqa: BLE001
+        print(f"unroll_vs_scan: FAIL ({e})", flush=True)
+        record["checks"].append({"name": "unroll_vs_scan", "pass": False,
+                                 "error": str(e)[:200]})
+        all_ok = False
+
     # long-context smoke: T=8192 fwd+bwd completes with O(block) VMEM
     try:
         bh, t_lc, hd = 4, t_long, 128
