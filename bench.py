@@ -475,6 +475,17 @@ def inner() -> int:
             )
 
     flash_block = None  # None = the kernel's default ladder choice
+    # record the layout actually taken: the native-(B,T,D) path only
+    # applies when the (h, hd) combination packs to 128 lanes (gpt2 12x64
+    # does; e.g. gpt2-xl's 25 heads can't pair) — claiming "btd" for a
+    # model that routed to the transpose path would misreport the artifact
+    _pcfg = GPTConfig.make(model_type=model)
+    from mingpt_distributed_tpu.ops import flash_attention as _fa
+
+    flash_layout = (
+        "btd" if _fa._btd_pack(_pcfg.n_head, _pcfg.head_dim) is not None
+        else "bh"
+    )
     if "flash" in results:
         # one bounded extra compile: layer-scan unroll at the winning batch
         # (lets XLA fuse across layer boundaries); only meaningful when the
@@ -521,6 +532,28 @@ def inner() -> int:
             ce_chunks["flash"] = 4
             print(f"flash loss_chunks=4: steps/sec={r[1]:.3f} (kept)",
                   file=sys.stderr)
+        # layout probe: the native-(B,T,D) kernels are the default (r5:
+        # +10% at b32 on a v5e); one bounded compile checks the transpose
+        # path hasn't overtaken it on THIS backend, and the record carries
+        # the winner either way. Skipped when the model can't take the btd
+        # path at all (probe would compare the transpose path to itself).
+        if flash_layout == "btd":
+            os.environ["FLASH_LAYOUT"] = "bh"
+            try:
+                r = bench_attention(
+                    "flash", batches=(results["flash"][0],),
+                    scan_unroll=unrolls["flash"], remat=remats["flash"],
+                    unroll_layers=layer_unrolls["flash"],
+                    loss_chunks=ce_chunks["flash"],
+                )
+            finally:
+                os.environ.pop("FLASH_LAYOUT", None)
+            if r is not None and r[1] > results["flash"][1]:
+                results["flash"] = r
+                flash_layout = "bh"
+                os.environ["FLASH_LAYOUT"] = "bh"  # for extras below
+                print(f"flash layout=bh: steps/sec={r[1]:.3f} (kept)",
+                      file=sys.stderr)
 
     if not results:
         print(json.dumps(_error_record("all attention paths failed or OOMed")))
@@ -560,6 +593,10 @@ def inner() -> int:
             "remat": remats.get(attention, False),
             "unroll_layers": layer_unrolls.get(attention, False),
             "loss_chunks": ce_chunks.get(attention, 8),
+            # the scan_unroll / FLASH_BLOCK / loss_chunks probes run for the
+            # flash path only (ADVICE r4): non-flash records carry the
+            # defaults and are slightly understated
+            "tuned": attention == "flash",
         }
     if not results:
         print(json.dumps(_error_record(
@@ -588,6 +625,7 @@ def inner() -> int:
             "unroll_layers": layer_unrolls.get(best, False),
             "loss_chunks": ce_chunks.get(best, 8),
             "flash_block": flash_block,  # None = default ladder
+            "flash_layout": flash_layout if best == "flash" else None,
             "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
             "flops_per_token": fpt,
             "achieved_tflops": round(tokens_per_sec * fpt / 1e12, 2),
@@ -629,25 +667,27 @@ def inner() -> int:
             out = fa.flash_with_lse(q, k, v, 1.0 / _math.sqrt(hd), 512, True)[0]
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
-        def timed_min(gfn, n=5, repeats=3):
-            """Best-of-repeats timing: independent dispatches through the
-            tunnel relay don't pipeline, so single windows are noisy (r4:
-            2.01x and 0.76x window_speedup on identical code the same
-            day); the min over repeated windows is the stable estimator."""
+        def timed_min(gfn, n=5, repeats=5):
+            """Min + spread over >= 5 timed windows: independent dispatches
+            through the tunnel relay don't pipeline, so single windows are
+            noisy (r4: 2.01x and 0.76x window_speedup on identical code the
+            same day). The min is the estimator; the per-trial list is
+            recorded so the artifact carries the variance, and the speedup
+            is only cited when the spread supports it (VERDICT r4 #8)."""
             for _ in range(2):
                 r = gfn(q, k, v)
             float(jax.device_get(r[0][0, 0, 0]))
-            best = float("inf")
+            trials = []
             for _ in range(repeats):
                 t0 = time.perf_counter()
                 for _ in range(n):
                     r = gfn(q, k, v)
                 float(jax.device_get(r[0][0, 0, 0]))
-                best = min(best, (time.perf_counter() - t0) / n)
-            return best
+                trials.append((time.perf_counter() - t0) / n)
+            return min(trials), trials
 
         g = jax.jit(jax.grad(attn_loss, argnums=(0, 1, 2)))
-        dt = timed_min(g)
+        dt, dt_trials = timed_min(g)
         # causal fwd 2 matmuls: 4*bh*T^2*hd/2 flops; bwd ~2.5x more
         flops = 3.5 * 4 * bh * t_lc * t_lc * hd / 2
         if peak and flops / dt > 1.2 * peak:
@@ -656,6 +696,7 @@ def inner() -> int:
                 f"TFLOP/s > 1.2x peak {peak / 1e12:.0f}")
         long_ctx = {
             "seq": t_lc, "ms_per_iter": round(dt * 1e3, 2),
+            "ms_trials": [round(t * 1e3, 2) for t in dt_trials],
             "attn_tflops": round(flops / dt / 1e12, 1),
         }
 
@@ -670,7 +711,7 @@ def inner() -> int:
             return jnp.sum(out.astype(jnp.float32) ** 2)
 
         gw = jax.jit(jax.grad(attn_loss_win, argnums=(0, 1, 2)))
-        dt_w = timed_min(gw)
+        dt_w, dt_w_trials = timed_min(gw)
         # banded rows attend ~window keys vs the causal average T/2, so
         # banded work ~= full * 2*win/T; same 1.2x-peak refusal applies
         flops_w = flops * 2 * win / t_lc
@@ -680,7 +721,23 @@ def inner() -> int:
         else:
             long_ctx["window"] = win
             long_ctx["window_ms_per_iter"] = round(dt_w * 1e3, 2)
-            long_ctx["window_speedup"] = round(dt / dt_w, 2)
+            long_ctx["window_ms_trials"] = [
+                round(t * 1e3, 2) for t in dt_w_trials
+            ]
+            # cite the speedup only when the spread supports it: if either
+            # set's trials vary more than the claimed effect, the number is
+            # relay noise, not a measurement (r4: 2.01x and 0.76x on
+            # identical code)
+            spread = max(
+                (max(ts) - min(ts)) / min(ts)
+                for ts in (dt_trials, dt_w_trials)
+            )
+            long_ctx["trial_spread"] = round(spread, 3)
+            speedup = dt / dt_w
+            if abs(speedup - 1.0) > spread:
+                long_ctx["window_speedup"] = round(speedup, 2)
+            else:
+                long_ctx["window_speedup_unstable"] = round(speedup, 2)
     except Exception as e:  # noqa: BLE001 — optional extra, never fatal
         print(f"long-context extra skipped: {e}", file=sys.stderr)
 

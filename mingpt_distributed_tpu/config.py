@@ -121,13 +121,15 @@ class GPTConfig:
     attention: str = "einsum"
     # Sliding-window (banded) attention, Mistral-style: each position sees
     # only the last `attention_window` tokens (itself included); None =
-    # full causal. Supported by the einsum oracle and the flash kernel
-    # (which skips out-of-band blocks: compute O(T*window), not O(T^2));
-    # not composed with ring/ulysses sequence parallelism.
+    # full causal. Supported by every attention impl: the einsum oracle,
+    # the flash kernel (which skips out-of-band blocks: compute
+    # O(T*window), not O(T^2)), and the ring/ulysses sequence-parallel
+    # paths (the ring turns banded with static hop skipping —
+    # test_sp_window_softcap.py).
     attention_window: Optional[int] = None
     # Gemma-2-style logit soft-capping: logits -> cap * tanh(logits / cap).
     # `attn_logit_softcap` applies to attention scores before masking
-    # (einsum oracle + flash kernel; not composed with ring/ulysses);
+    # (every impl, incl. ring/ulysses — test_sp_window_softcap.py);
     # `final_logit_softcap` applies to the LM-head logits (loss, chunked
     # loss, and generation alike). None disables.
     attn_logit_softcap: Optional[float] = None
@@ -384,6 +386,14 @@ class TrainerConfig:
     # Write msgpack snapshots from a background thread (the host copy is
     # taken synchronously; serialization + object-store IO overlap training).
     async_save: bool = False
+    # Multi-host msgpack saves gather the FULL state to EVERY host
+    # (process_allgather) before process 0 writes the single blob — fine at
+    # gpt2-124M, hopeless for billion-parameter state on a pod. Saves above
+    # this many MB refuse with a pointer to the Orbax backend (sharded
+    # collective writes, no gather; use a snapshot_path without the
+    # .msgpack suffix). Raise deliberately if your hosts really have the
+    # RAM and you want the single-blob format anyway.
+    msgpack_gather_limit_mb: int = 8192
     # Accumulate gradients over this many micro-batches per optimizer step
     # (one lax.scan inside the same jitted step): activation memory scales
     # with batch_size/grad_accum_steps, semantics stay the full batch.

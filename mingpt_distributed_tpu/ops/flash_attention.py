@@ -49,6 +49,16 @@ from mingpt_distributed_tpu.ops import attention as attn_ops
 
 NEG_INF = -1e30
 
+# Base-2 softmax rebase (round-5, measured): the VPU evaluates exp2 ~6%
+# faster than exp (tools/exp_exp2.py: 72.4 vs 68.2 G/s), and log2(e) folds
+# into the attention scale constant, so every kernel tracks scores, running
+# max and alpha in base 2 at ZERO extra per-element ops — exp becomes exp2,
+# nothing else changes. The saved log-sum-exp stays in the NATURAL domain
+# (one per-row multiply at finalize): ring-attention merging and the dlse
+# cotangent contract are unchanged. exp2(x * LOG2E) == exp(x).
+LOG2E = 1.4426950408889634
+INV_LOG2E = 1.0 / LOG2E
+
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
@@ -153,9 +163,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q, kblk,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # (BQ, BK)
+        )  # (BQ, BK)
+        # scores in BASE 2 from here on (see LOG2E note): the rebase
+        # constants fold into `scale` (and the softcap multipliers)
         if softcap is not None:  # Gemma-2 soft-cap, before masking
-            s = softcap * jnp.tanh(s / softcap)
+            s = (softcap * LOG2E) * jnp.tanh(s * (scale / softcap))
+        else:
+            s = s * (scale * LOG2E)
         if causal:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -185,8 +199,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
         m = m_scr[...]
         m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
+        p = jnp.exp2(s - m_new)
+        alpha = jnp.exp2(m - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
@@ -204,10 +218,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         # with m = NEG_INF. Both cases emit lse ~= NEG_INF (m + log(l)),
         # which LSE-merging callers weight to exactly zero — `out` for
         # dead rows is garbage by contract, lse is the signal. Live rows
-        # have l >= exp(0) = 1 from their max entry, so values are exact.
+        # have l >= exp2(0) = 1 from their max entry, so values are exact.
         l_safe = jnp.maximum(l, 1e-30)
         o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = m + jnp.log(l_safe)  # (BQ, 1)
+        # natural-domain lse (m is base-2): API contract for ring merging
+        lse_ref[0] = m * INV_LOG2E + jnp.log(l_safe)  # (BQ, 1)
 
 
 def _flash_fwd(q, k, v, scale, block, causal=True, window=None, softcap=None,
@@ -300,19 +315,21 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         # p/ds are computed in fp32 and cast back only to feed the MXU
         q = q_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]  # (BQ, 1)
+        lse = lse_ref[0] * LOG2E  # natural -> base-2 (per-row, cheap)
         delta = delta_ref[0]
         kblk = k_ref[0]
         vblk = v_ref[0]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
+        )
+        # base-2 scores (LOG2E note); the tanh output is kept UNMASKED for
+        # the softcap derivative — the factor stays bounded in [0, 1]
         if softcap is not None:
-            # keep the UNMASKED capped scores for the tanh derivative: the
-            # factor stays bounded in [0, 1] (masked entries would overflow)
-            s = softcap * jnp.tanh(s / softcap)
-        sc = s
+            t = jnp.tanh(s * (scale / softcap))
+            s = (softcap * LOG2E) * t
+        else:
+            s = s * (scale * LOG2E)
         p = None
         if causal:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
@@ -325,17 +342,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jnp.where(ok, s, NEG_INF)
             # mask p structurally, not via exp underflow: a dead row
             # (q_offset > 0, no live key) has lse ~= NEG_INF, making
-            # exp(NEG_INF - lse) = exp(~0) = 1 garbage rather than 0
-            p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+            # exp2(NEG_INF - lse) = exp2(~0) = 1 garbage rather than 0
+            p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
         if p is None:
-            p = jnp.exp(s - lse)
+            p = jnp.exp2(s - lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         ds = p * (dp - delta.astype(jnp.float32))
         if softcap is not None:  # chain through d/ds cap*tanh(s/cap)
-            ds = ds * (1.0 - (sc / softcap) ** 2)
+            ds = ds * (1.0 - t * t)
         ds = ds * scale
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
@@ -375,15 +392,18 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         vblk = v_ref[0]
         q = q_ref[0]  # (BQ, hd)
         do = do_ref[0]
-        lse = lse_ref[0]  # (BQ, 1)
+        lse = lse_ref[0] * LOG2E  # natural -> base-2
         delta = delta_ref[0]
         s = jax.lax.dot_general(
             q, kblk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
+        )
+        # base-2 scores; unmasked tanh kept for the derivative factor
         if softcap is not None:
-            s = softcap * jnp.tanh(s / softcap)
-        sc = s  # unmasked capped scores (tanh-derivative factor)
+            t = jnp.tanh(s * (scale / softcap))
+            s = (softcap * LOG2E) * t
+        else:
+            s = s * (scale * LOG2E)
         p = None
         if causal:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
@@ -395,9 +415,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 ok = ok & (q_pos - k_pos < window)
             s = jnp.where(ok, s, NEG_INF)
             # structural masking — see _dq_kernel's dead-row note
-            p = jnp.where(ok, jnp.exp(s - lse), 0.0)
+            p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
         if p is None:
-            p = jnp.exp(s - lse)  # (BQ, BK)
+            p = jnp.exp2(s - lse)  # (BQ, BK)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -408,7 +428,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         )
         ds = p * (dp - delta.astype(jnp.float32))
         if softcap is not None:
-            ds = ds * (1.0 - (sc / softcap) ** 2)
+            ds = ds * (1.0 - t * t)
         ds = ds * scale
         dk_scr[...] += jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
@@ -588,6 +608,461 @@ def _flash_lse_bwd_rule(scale, block, causal, window, softcap, q_offset,
 flash_with_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
+# ---------------------------------------------------------------------------
+# Native-layout (B, T, D) kernels — no activation transposes
+# ---------------------------------------------------------------------------
+#
+# The square kernels above take (B*H, T, hd): the model's activations are
+# (B, T, H*hd), so every call pays a (0, 2, 1, 3) transpose on the way in
+# and out — at hd=64 that was the single largest step-time sink left on the
+# round-4 trace (~29 ms/step at batch 16; BASELINE.md round-5 plan #1).
+# These kernels keep the native layout and make the HEAD a grid dimension:
+# grid (B, H/pack, nq, nk) where `pack` sub-heads ride one cell so the lane
+# dimension stays at Mosaic's 128 minimum (hd=64 -> 2 heads per cell, which
+# also halves the grid and builds the causal mask once per PAIR of heads).
+# The kernel bodies are the same online-softmax / lse-delta cells as above,
+# re-indexed for the 4D grid. Measured on a TPU v5e chip (batch 16, T=1024,
+# GPT-2 dims): fwd+bwd 3.82 ms vs 4.46 ms for kernels+transposes per layer
+# call — the win that took the step from MFU 0.47 toward 0.55.
+
+
+def _btd_pack(h: int, hd: int) -> Optional[int]:
+    """Sub-heads per grid cell for the native-layout kernels, or None when
+    the (h, hd) combination can't keep the lane dimension at 128."""
+    if hd >= 128:
+        return 1 if hd % 128 == 0 else None
+    if 128 % hd == 0:
+        p = 128 // hd
+        return p if h % p == 0 else None
+    return None
+
+
+def _fwd_kernel_btd(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                    acc_scr, *, scale, block, hd, pack, window=None,
+                    softcap=None):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    def _compute(masked):
+        q_all = q_ref[0]  # (block, pack*hd)
+        k_all = k_ref[0]
+        v_all = v_ref[0]
+        if masked:
+            # causal/band mask built ONCE per cell, shared by all sub-heads
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = kj * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+        for sh in range(pack):
+            lo, hi = sh * hd, (sh + 1) * hd
+            q = q_all[:, lo:hi]
+            kblk = k_all[:, lo:hi]
+            vblk = v_all[:, lo:hi]
+            s = jax.lax.dot_general(
+                q, kblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # base-2 scores (LOG2E note at the top of the file)
+            if softcap is not None:
+                s = (softcap * LOG2E) * jnp.tanh(s * (scale / softcap))
+            else:
+                s = s * (scale * LOG2E)
+            if masked:
+                # wipe-by-underflow invariant holds exactly as in
+                # _fwd_kernel (q_offset is always 0 here: every q row owns
+                # a live diagonal)
+                s = jnp.where(ok, s, NEG_INF)
+            m = m_scr[sh]
+            m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp2(s - m_new)
+            alpha = jnp.exp2(m - m_new)
+            m_scr[sh] = m_new
+            l_scr[sh] = l_scr[sh] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[sh] = acc_scr[sh] * alpha + jax.lax.dot_general(
+                p.astype(vblk.dtype), vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    # Diagonal-block specialisation (round-5): with square block tiling and
+    # no window, every active cell strictly below the diagonal is FULLY
+    # visible — no iota/compare/where per score, a large cut in a kernel
+    # that is VPU-bound, not MXU-bound, at hd=64. Banded attention keeps
+    # the generic masked body on every active cell (band edges cross it).
+    if window is not None:
+        active = (kj <= _kv_hi(qi, block, 0, nk)) & (
+            kj >= _kv_lo(qi, block, window, 0))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    else:
+        @pl.when(kj == qi)
+        def _diag():
+            _compute(True)
+
+        @pl.when(kj < qi)
+        def _full():
+            _compute(False)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_scr[...], 1e-30)  # (pack, block, 1)
+        o_sub = acc_scr[...] / l_safe  # (pack, block, hd)
+        if pack == 1:
+            o_ref[0] = o_sub[0].astype(o_ref.dtype)
+        else:
+            o_ref[0] = jnp.concatenate(
+                [o_sub[i] for i in range(pack)], axis=1).astype(o_ref.dtype)
+        # natural-domain lse from base-2 m (same contract as _fwd_kernel)
+        lse = m_scr[...] * INV_LOG2E + jnp.log(l_safe)  # (pack, block, 1)
+        for sh in range(pack):
+            lse_ref[0, sh] = lse[sh]
+
+
+def _dq_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_scr, *, scale, block, hd, pack, window=None,
+                   softcap=None):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    def _compute(masked):
+        q_all = q_ref[0]
+        k_all = k_ref[0]
+        v_all = v_ref[0]
+        do_all = do_ref[0]
+        if masked:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = kj * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+        for sh in range(pack):
+            lo, hi = sh * hd, (sh + 1) * hd
+            q = q_all[:, lo:hi]
+            kblk = k_all[:, lo:hi]
+            vblk = v_all[:, lo:hi]
+            do = do_all[:, lo:hi]
+            lse = lse_ref[0, sh] * LOG2E  # natural -> base-2
+            delta = delta_ref[0, sh]
+            s = jax.lax.dot_general(
+                q, kblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # base-2 scores; unmasked tanh kept for the derivative factor
+            if softcap is not None:
+                t = jnp.tanh(s * (scale / softcap))
+                s = (softcap * LOG2E) * t
+            else:
+                s = s * (scale * LOG2E)
+            if masked:
+                s = jnp.where(ok, s, NEG_INF)
+                p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
+            else:
+                p = jnp.exp2(s - lse)
+            dp = jax.lax.dot_general(
+                do, vblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta.astype(jnp.float32))
+            if softcap is not None:
+                ds = ds * (1.0 - t * t)
+            ds = ds * scale
+            dq_scr[sh] += jax.lax.dot_general(
+                ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    # diagonal-block specialisation — see _fwd_kernel_btd
+    if window is not None:
+        active = (kj <= _kv_hi(qi, block, 0, nk)) & (
+            kj >= _kv_lo(qi, block, window, 0))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    else:
+        @pl.when(kj == qi)
+        def _diag():
+            _compute(True)
+
+        @pl.when(kj < qi)
+        def _full():
+            _compute(False)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        if pack == 1:
+            dq_ref[0] = dq_scr[0].astype(dq_ref.dtype)
+        else:
+            dq_ref[0] = jnp.concatenate(
+                [dq_scr[i] for i in range(pack)], axis=1).astype(dq_ref.dtype)
+
+
+def _dkv_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, block, hd,
+                    pack, window=None, softcap=None):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute(masked):
+        q_all = q_ref[0]
+        k_all = k_ref[0]
+        v_all = v_ref[0]
+        do_all = do_ref[0]
+        if masked:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = kj * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+        for sh in range(pack):
+            lo, hi = sh * hd, (sh + 1) * hd
+            q = q_all[:, lo:hi]
+            kblk = k_all[:, lo:hi]
+            vblk = v_all[:, lo:hi]
+            do = do_all[:, lo:hi]
+            lse = lse_ref[0, sh] * LOG2E  # natural -> base-2
+            delta = delta_ref[0, sh]
+            s = jax.lax.dot_general(
+                q, kblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            # base-2 scores; unmasked tanh kept for the derivative factor
+            if softcap is not None:
+                t = jnp.tanh(s * (scale / softcap))
+                s = (softcap * LOG2E) * t
+            else:
+                s = s * (scale * LOG2E)
+            if masked:
+                s = jnp.where(ok, s, NEG_INF)
+                p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
+            else:
+                p = jnp.exp2(s - lse)
+            dv_scr[sh] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, vblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta.astype(jnp.float32))
+            if softcap is not None:
+                ds = ds * (1.0 - t * t)
+            ds = ds * scale
+            dk_scr[sh] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    # diagonal-block specialisation — see _fwd_kernel_btd (here the grid
+    # streams q per k block, so the fully-visible cells are qi > kj)
+    if window is not None:
+        active = (qi >= _q_lo(kj, block, 0)) & (
+            qi <= _q_hi(kj, block, window, 0))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    else:
+        @pl.when(qi == kj)
+        def _diag():
+            _compute(True)
+
+        @pl.when(qi > kj)
+        def _full():
+            _compute(False)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        if pack == 1:
+            dk_ref[0] = dk_scr[0].astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[0].astype(dv_ref.dtype)
+        else:
+            dk_ref[0] = jnp.concatenate(
+                [dk_scr[i] for i in range(pack)], axis=1).astype(dk_ref.dtype)
+            dv_ref[0] = jnp.concatenate(
+                [dv_scr[i] for i in range(pack)], axis=1).astype(dv_ref.dtype)
+
+
+def _flash_fwd_btd(q, k, v, h, scale, block, window=None, softcap=None):
+    """q/k/v (B, T, H*hd) -> out (B, T, H*hd), lse (B, H, T, 1) fp32."""
+    b, t, d = q.shape
+    hd = d // h
+    pack = _btd_pack(h, hd)
+    nb = t // block
+    grid = (b, h // pack, nb, nb)
+
+    if window is not None:
+        def kv_idx(bb, hh, i, j):
+            return (bb, jnp.clip(j, _kv_lo(i, block, window, 0),
+                                 _kv_hi(i, block, 0, nb)), hh)
+    else:
+        def kv_idx(bb, hh, i, j):
+            return (bb, jnp.minimum(j, _kv_hi(i, block, 0, nb)), hh)
+
+    io_spec = pl.BlockSpec((1, block, pack * hd),
+                           lambda bb, hh, i, j: (bb, i, hh))
+    kv_spec = pl.BlockSpec((1, block, pack * hd), kv_idx)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_btd, scale=scale, block=block, hd=hd,
+                          pack=pack, window=window, softcap=softcap),
+        grid=grid,
+        in_specs=[io_spec, kv_spec, kv_spec],
+        out_specs=[
+            io_spec,
+            pl.BlockSpec((1, pack, block, 1),
+                         lambda bb, hh, i, j: (bb, hh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, t, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((pack, block, 1), jnp.float32),
+            pltpu.VMEM((pack, block, 1), jnp.float32),
+            pltpu.VMEM((pack, block, hd), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
+                   softcap=None):
+    """Native-layout backward: dq, dk, dv in (B, T, H*hd)."""
+    b, t, d = q.shape
+    hd = d // h
+    pack = _btd_pack(h, hd)
+    nb = t // block
+    # delta = rowsum(out * do) per head: (B, T, H) -> (B, H, T, 1). The
+    # transpose is on a (B, H, T) fp32 vector — trivial next to the (B, T,
+    # D) activation transposes this path exists to kill.
+    delta = jnp.sum(
+        out.astype(jnp.float32).reshape(b, t, h, hd)
+        * do.astype(jnp.float32).reshape(b, t, h, hd), axis=-1)
+    delta = delta.transpose(0, 2, 1)[..., None]
+
+    grid = (b, h // pack, nb, nb)
+    io_q = pl.BlockSpec((1, block, pack * hd),
+                        lambda bb, hh, i, j: (bb, i, hh))
+    if window is not None:
+        kv_stream = pl.BlockSpec(
+            (1, block, pack * hd),
+            lambda bb, hh, i, j: (bb, jnp.clip(
+                j, _kv_lo(i, block, window, 0), _kv_hi(i, block, 0, nb)),
+                hh))
+    else:
+        kv_stream = pl.BlockSpec(
+            (1, block, pack * hd),
+            lambda bb, hh, i, j: (bb, jnp.minimum(
+                j, _kv_hi(i, block, 0, nb)), hh))
+    vec_q = pl.BlockSpec((1, pack, block, 1),
+                         lambda bb, hh, i, j: (bb, hh, i, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel_btd, scale=scale, block=block, hd=hd,
+                          pack=pack, window=window, softcap=softcap),
+        grid=grid,
+        in_specs=[io_q, kv_stream, kv_stream, io_q, vec_q, vec_q],
+        out_specs=[io_q],
+        out_shape=[jax.ShapeDtypeStruct((b, t, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((pack, block, hd), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)[0]
+
+    kv_fixed = pl.BlockSpec((1, block, pack * hd),
+                            lambda bb, hh, j, i: (bb, j, hh))
+    if window is not None:
+        def _q_idx(bb, hh, j, i):
+            return (bb, jnp.clip(jnp.clip(
+                i, _q_lo(j, block, 0), _q_hi(j, block, window, 0)),
+                0, nb - 1), hh)
+
+        def _vec_idx(bb, hh, j, i):
+            return (bb, hh, jnp.clip(jnp.clip(
+                i, _q_lo(j, block, 0), _q_hi(j, block, window, 0)),
+                0, nb - 1), 0)
+    else:
+        def _q_idx(bb, hh, j, i):
+            return (bb, jnp.maximum(i, _q_lo(j, block, 0)), hh)
+
+        def _vec_idx(bb, hh, j, i):
+            return (bb, hh, jnp.maximum(i, _q_lo(j, block, 0)), 0)
+    q_stream = pl.BlockSpec((1, block, pack * hd), _q_idx)
+    vec_stream = pl.BlockSpec((1, pack, block, 1), _vec_idx)
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel_btd, scale=scale, block=block, hd=hd,
+                          pack=pack, window=window, softcap=softcap),
+        grid=grid,
+        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, vec_stream,
+                  vec_stream],
+        out_specs=[kv_fixed, kv_fixed],
+        out_shape=[jax.ShapeDtypeStruct((b, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, t, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((pack, block, hd), jnp.float32),
+                        pltpu.VMEM((pack, block, hd), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_btd(q, k, v, h: int, scale: float, block: int, window=None,
+               softcap=None):
+    out, _ = _flash_fwd_btd(q, k, v, h, scale, block, window=window,
+                            softcap=softcap)
+    return out
+
+
+def _flash_btd_fwd_rule(q, k, v, h, scale, block, window, softcap):
+    out, lse = _flash_fwd_btd(q, k, v, h, scale, block, window=window,
+                              softcap=softcap)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_btd_bwd_rule(h, scale, block, window, softcap, res, do):
+    q, k, v, out, lse = res
+    return _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block,
+                          window=window, softcap=softcap)
+
+
+_flash_btd.defvjp(_flash_btd_fwd_rule, _flash_btd_bwd_rule)
+
+
 def causal_attention(
     q: jax.Array,  # (B, T, H, hd)
     k: jax.Array,  # (B, S, KV, hd)
@@ -640,9 +1115,18 @@ def causal_attention(
     k = attn_ops.repeat_kv(k, h // kv)
     v = attn_ops.repeat_kv(v, h // kv)
     scale = 1.0 / math.sqrt(hd)
+    win = None if window is None else int(window)
+    cap = None if logit_softcap is None else float(logit_softcap)
+    # Native-layout path: the model's activations are (B, T, H*hd) under
+    # the hood, so the reshape below is free where to_bh pays two real
+    # transposes per call (the round-4 trace's biggest remaining sink).
+    # FLASH_LAYOUT=bh forces the transpose path (bench A/B escape hatch).
+    if (_btd_pack(h, hd) is not None
+            and os.environ.get("FLASH_LAYOUT", "auto") != "bh"):
+        out2 = _flash_btd(q.reshape(b, t, h * hd), k.reshape(b, t, h * hd),
+                          v.reshape(b, t, h * hd), h, scale, block, win, cap)
+        return out2.reshape(b, t, h, hd)
     # (B, T, H, hd) -> (B*H, T, hd)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block,
-                 None if window is None else int(window),
-                 None if logit_softcap is None else float(logit_softcap))
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block, win, cap)
     return out.reshape(b, h, t, hd).transpose(0, 2, 1, 3)

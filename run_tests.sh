@@ -4,8 +4,31 @@
 # PYTHONPATH/PALLAS_AXON_POOL_IPS are cleared so any TPU-plugin
 # sitecustomize hook in the ambient environment doesn't dial real hardware
 # from every test process; JAX_PLATFORMS=cpu + forced host device count give
-# the same pjit/shard_map semantics as an 8-chip slice.
+# the same pjit/shard_map semantics as an 8-chip slice. (The ambient hook
+# also drops CPU matmul precision — fp32 parity tests FAIL outside this
+# wrapper.)
+#
+# Tiers (pytest markers):
+#   default            -m "not slow and not mid"  — the fast gate
+#   mid                heaviest shard_map/pipeline compile cases
+#   slow               multi-process integration tests (real process pairs)
+# Run everything:  ./run_tests.sh -m ""
+#
+# The persistent compilation cache makes repeat runs much cheaper (the
+# suite is compile-dominated: ~40% off the heaviest pipeline cases once
+# warm). Safe to delete .jax_test_cache at any time.
 set -euo pipefail
 cd "$(dirname "$0")"
+
+has_m=0
+for a in "$@"; do
+  [[ "$a" == "-m" ]] && has_m=1
+done
+if [[ $has_m -eq 0 ]]; then
+  set -- -m "not slow and not mid" "$@"
+fi
+
 exec env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python -m pytest tests/ "$@"

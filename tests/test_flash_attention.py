@@ -195,3 +195,105 @@ def test_flash_with_lse_cotangent():
     for a, b_ in zip(g_f, g_d):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    rtol=1e-4, atol=1e-4)
+
+
+# --- native-layout (B, T, D) kernel path -----------------------------------
+
+
+def _both_layouts(q, k, v, monkeypatch, **kw):
+    """Run flash.causal_attention with the btd path and the transpose path."""
+    monkeypatch.setenv("FLASH_LAYOUT", "auto")
+    got_btd = flash.causal_attention(q, k, v, **kw)
+    monkeypatch.setenv("FLASH_LAYOUT", "bh")
+    got_bh = flash.causal_attention(q, k, v, **kw)
+    return got_btd, got_bh
+
+
+def test_btd_pack_table():
+    assert flash._btd_pack(12, 64) == 2   # gpt2
+    assert flash._btd_pack(4, 32) == 4
+    assert flash._btd_pack(32, 128) == 1  # llama-shaped
+    assert flash._btd_pack(3, 64) is None   # odd head count can't pair
+    assert flash._btd_pack(4, 48) is None   # 48 doesn't divide 128
+
+
+def test_btd_forward_and_grad_parity(monkeypatch):
+    """The native-layout path must agree with the transpose path AND the
+    oracle (fwd + all grads) — h=4/hd=32 routes to pack=4."""
+    q, k, v = qkv(t=256, seed=13)
+    got_btd, got_bh = _both_layouts(q, k, v, monkeypatch)
+    want = attn_ops.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got_btd), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_bh), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v)))
+
+    monkeypatch.setenv("FLASH_LAYOUT", "auto")
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    for want_g, got_g, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(want_g), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch (btd)",
+        )
+
+
+def test_btd_pack1_head_dim_128(monkeypatch):
+    """hd=128 -> pack=1 (llama head dim): single-head cells, no pairing."""
+    q, k, v = qkv(t=128, h=2, hd=128, seed=17)
+    got_btd, got_bh = _both_layouts(q, k, v, monkeypatch)
+    want = attn_ops.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got_btd), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got_btd), np.asarray(got_bh),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_btd_window_softcap_grad_parity(monkeypatch):
+    """Sliding window + logit softcap compose on the native-layout path,
+    forward and backward (the mistral/gemma kernel features)."""
+    q, k, v = qkv(t=256, seed=19)
+    kw = dict(window=40, logit_softcap=30.0)
+    got_btd, got_bh = _both_layouts(q, k, v, monkeypatch, **kw)
+    want = attn_ops.causal_attention(q, k, v, **kw)
+    np.testing.assert_allclose(np.asarray(got_btd), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v, **kw)))
+
+    monkeypatch.setenv("FLASH_LAYOUT", "auto")
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    for want_g, got_g, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(want_g), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch (btd window+softcap)",
+        )
+
+
+def test_btd_gqa_grad_parity(monkeypatch):
+    """GQA routes through repeat_kv OUTSIDE the custom vjp: autodiff must
+    sum dk/dv over the query-head group exactly as the oracle does."""
+    q, k, v = qkv(t=128, h=4, kv=2, seed=23)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v)))
+
+    monkeypatch.setenv("FLASH_LAYOUT", "auto")
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    for want_g, got_g, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(want_g), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch (btd gqa)",
+        )

@@ -234,6 +234,7 @@ def test_pp_with_ep_matches_no_pp(eight_devices):
     np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-4)
 
 
+@pytest.mark.mid
 def test_pp_with_ep_keeps_experts_sharded_in_region(eight_devices,
                                                     monkeypatch):
     """The in-region sharding assert: inside the pp x ep region each shard
@@ -455,6 +456,7 @@ def test_1f1b_with_tp_gradients(eight_devices):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.mid
 def test_1f1b_matches_gpipe_with_dropout(eight_devices):
     """Same rng => identical loss under both schedules (the 1f1b custom vjp
     must carry the non-differentiable per-layer PRNG keys through its
@@ -485,6 +487,7 @@ def test_1f1b_matches_gpipe_with_dropout(eight_devices):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.mid
 def test_1f1b_with_moe_aux_gradients(eight_devices):
     """The aux (load-balancing) loss cotangent flows through the 1f1b
     backward: grads must match the dense run including the aux term."""
@@ -506,6 +509,7 @@ def test_1f1b_with_moe_aux_gradients(eight_devices):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.mid
 def test_pp_tp_sp_triple_composition(eight_devices):
     """pp=2 x tp=2 x sp=2 with ring attention: megatron-tp (local heads)
     composes with the zigzag ring over sp INSIDE pipeline stages — logits
@@ -534,6 +538,7 @@ def test_pp_tp_sp_triple_composition(eight_devices):
                                    rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.mid
 def test_pp_tp_flash_window_softcap(eight_devices):
     """The Pallas flash kernel — with sliding window AND logit softcap —
     runs inside the pipeline's manual region composed with megatron-tp:
@@ -560,6 +565,7 @@ def test_pp_tp_flash_window_softcap(eight_devices):
     np.testing.assert_allclose(float(got_loss), float(want_loss), rtol=1e-5)
 
 
+@pytest.mark.mid
 def test_pp_sp_attention_dropout_runs(eight_devices):
     """VERDICT r3 weak #4: the reference-parity default attn_pdrop=0.1 must
     train under pp x sp — the refusal is lifted and the manual-sp shard
@@ -587,6 +593,7 @@ def test_pp_sp_attention_dropout_runs(eight_devices):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.mid
 def test_pp_ulysses_sp_attention_dropout_runs(eight_devices):
     cfg, params, tokens = cfg_and_inputs(
         n_head=4, attention="ulysses", attn_pdrop=0.3
@@ -602,6 +609,7 @@ def test_pp_ulysses_sp_attention_dropout_runs(eight_devices):
     assert np.isfinite(float(l1))
 
 
+@pytest.mark.mid
 def test_pp_dropout_decorrelated_across_dp(eight_devices):
     """dp shards inside the pipeline's manual region hold DIFFERENT rows
     but previously drew identical masks from the replicated layer key: with

@@ -307,3 +307,23 @@ def test_grad_accum_matches_full_batch(tmp_path):
         tr.state, m = tr._train_step(tr.state, tr._put_batch(xy), tr.base_rng)
         losses.append(float(jax.device_get(m["loss"])))
     np.testing.assert_allclose(losses, l_full, rtol=2e-5, atol=1e-6)
+
+
+def test_multihost_msgpack_gather_refused_above_limit(tmp_path):
+    """A multi-host msgpack save must REFUSE the full-state allgather when
+    the state exceeds the configured limit, pointing at the Orbax backend
+    (trainer.save_snapshot; the gather is fine at 124M, hopeless at 8B)."""
+    tr = make_trainer(tmp_path, msgpack_gather_limit_mb=0)
+    tr.process_count = 2  # simulate a pod: the guard fires before any
+    # collective, so no second process is needed to reach it
+    with pytest.raises(RuntimeError, match="Orbax"):
+        tr.save_snapshot(epoch=0)
+
+
+def test_async_save_with_orbax_backend_refused(tmp_path):
+    """async_save only overlaps msgpack writes; an Orbax snapshot_path must
+    error loudly instead of silently saving synchronously."""
+    from mingpt_distributed_tpu.config import ConfigError
+
+    with pytest.raises(ConfigError, match="async_save"):
+        make_trainer(tmp_path, snapshot="orbax_dir", async_save=True)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Diagnose the batch>=64 remote-compile failure (round-5 task #2).
+
+r2-r4: the bench ladder's batch-64 training step fails remote compile with
+an opaque `HTTP 500: tpu_compile_helper subprocess exit code 1`; the
+ladder settles at 32. This tool (a) reproduces the failure and captures
+the FULL exception text to stderr/a file, (b) sizes the live-activation
+story analytically, and (c) when the backend is CPU, compiles the same
+step and prints XLA's memory_analysis for the artifact.
+
+Usage: python tools/exp_b64.py [batch ...]  (default 48 64)
+"""
+import json
+import os
+import sys
+import time
+import traceback
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
+from mingpt_distributed_tpu.models import gpt
+from mingpt_distributed_tpu.training.optimizer import make_optimizer
+from mingpt_distributed_tpu.training.trainer import make_train_step
+
+SEQ = 1024
+
+
+def try_batch(batch, remat=False, run=True):
+    cfg = GPTConfig.make(
+        model_type="gpt2",
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="bfloat16", attention="flash", unroll_layers=True,
+        remat=remat, block_size=SEQ,
+    )
+    optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
+    state = jax.jit(
+        lambda k: {
+            "params": gpt.init(k, cfg),
+            "opt_state": optimizer.init(gpt.init(k, cfg)),
+            "step": jnp.asarray(0, dtype=jnp.int32),
+        }
+    )(jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (batch, SEQ), 0, cfg.vocab_size, dtype=jnp.int32
+    )
+    t0 = time.perf_counter()
+    lowered = jax.jit(
+        make_train_step(cfg, optimizer), donate_argnums=(0,)
+    ).lower(state, (tokens, tokens), jax.random.key(2))
+    compiled = lowered.compile()
+    rec = {"batch": batch, "remat": remat,
+           "compile_s": round(time.perf_counter() - t0, 1)}
+    try:
+        mem = compiled.memory_analysis()
+        if mem is not None:
+            for k in ("temp_size_in_bytes", "argument_size_in_bytes",
+                      "output_size_in_bytes", "generated_code_size_in_bytes"):
+                v = getattr(mem, k, None)
+                if v is not None:
+                    rec[k.replace("_in_bytes", "_mb")] = round(v / 2**20, 1)
+    except Exception:  # noqa: BLE001 — analysis is optional evidence
+        pass
+    if run:
+        state, m = compiled(state, (tokens, tokens), jax.random.key(2))
+        loss = float(jax.device_get(m["loss"]))
+        assert loss == loss
+        rec["ran"] = True
+        rec["loss"] = round(loss, 3)
+    return rec
+
+
+def main():
+    batches = [int(a) for a in sys.argv[1:]] or [48, 64]
+    for batch in batches:
+        for remat in (False, True) if batch >= 64 else (False,):
+            try:
+                rec = try_batch(batch, remat=remat)
+            except Exception as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                print(tb, file=sys.stderr, flush=True)
+                rec = {"batch": batch, "remat": remat,
+                       "error": repr(e)[:400]}
+            print(json.dumps(rec), flush=True)
+
+
+if __name__ == "__main__":
+    main()
