@@ -483,7 +483,9 @@ def inner() -> int:
     from mingpt_distributed_tpu.ops import flash_attention as _fa
 
     flash_layout = (
-        "btd" if _fa._btd_pack(_pcfg.n_head, _pcfg.head_dim) is not None
+        "btd"
+        if (_fa._btd_pack(_pcfg.n_head, _pcfg.head_dim) is not None
+            and os.environ.get("FLASH_LAYOUT", "auto") != "bh")
         else "bh"
     )
     if "flash" in results:
@@ -538,6 +540,7 @@ def inner() -> int:
         # the winner either way. Skipped when the model can't take the btd
         # path at all (probe would compare the transpose path to itself).
         if flash_layout == "btd":
+            prior_layout = os.environ.get("FLASH_LAYOUT")
             os.environ["FLASH_LAYOUT"] = "bh"
             try:
                 r = bench_attention(
@@ -547,7 +550,10 @@ def inner() -> int:
                     loss_chunks=ce_chunks["flash"],
                 )
             finally:
-                os.environ.pop("FLASH_LAYOUT", None)
+                if prior_layout is None:
+                    os.environ.pop("FLASH_LAYOUT", None)
+                else:
+                    os.environ["FLASH_LAYOUT"] = prior_layout
             if r is not None and r[1] > results["flash"][1]:
                 results["flash"] = r
                 flash_layout = "bh"

@@ -60,6 +60,26 @@ LOG2E = 1.4426950408889634
 INV_LOG2E = 1.0 / LOG2E
 
 
+def _scores_base2(q, kblk, scale, softcap):
+    """The shared per-cell score computation: QK^T -> optional softcap ->
+    BASE-2 scores with the rebase constants folded in (see LOG2E note).
+
+    Returns (s, t): s = base-2 scores, t = the raw tanh output when
+    softcap is active (the backward's derivative factor is 1 - t*t;
+    kept UNMASKED so it stays bounded in [0, 1]), else None. One
+    definition for all six kernels — the math must never diverge between
+    them.
+    """
+    s = jax.lax.dot_general(
+        q, kblk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if softcap is not None:
+        t = jnp.tanh(s * (scale / softcap))
+        return (softcap * LOG2E) * t, t
+    return s * (scale * LOG2E), None
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -159,17 +179,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         q = q_ref[0]  # (BQ, hd)
         kblk = k_ref[0]  # (BK, hd)
         vblk = v_ref[0]
-        s = jax.lax.dot_general(
-            q, kblk,
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # (BQ, BK)
-        # scores in BASE 2 from here on (see LOG2E note): the rebase
-        # constants fold into `scale` (and the softcap multipliers)
-        if softcap is not None:  # Gemma-2 soft-cap, before masking
-            s = (softcap * LOG2E) * jnp.tanh(s * (scale / softcap))
-        else:
-            s = s * (scale * LOG2E)
+        s, _ = _scores_base2(q, kblk, scale, softcap)  # (BQ, BK)
         if causal:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
@@ -319,17 +329,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         delta = delta_ref[0]
         kblk = k_ref[0]
         vblk = v_ref[0]
-        s = jax.lax.dot_general(
-            q, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # base-2 scores (LOG2E note); the tanh output is kept UNMASKED for
-        # the softcap derivative — the factor stays bounded in [0, 1]
-        if softcap is not None:
-            t = jnp.tanh(s * (scale / softcap))
-            s = (softcap * LOG2E) * t
-        else:
-            s = s * (scale * LOG2E)
+        s, t = _scores_base2(q, kblk, scale, softcap)
         p = None
         if causal:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
@@ -394,16 +394,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]
         lse = lse_ref[0] * LOG2E  # natural -> base-2
         delta = delta_ref[0]
-        s = jax.lax.dot_general(
-            q, kblk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        # base-2 scores; unmasked tanh kept for the derivative factor
-        if softcap is not None:
-            t = jnp.tanh(s * (scale / softcap))
-            s = (softcap * LOG2E) * t
-        else:
-            s = s * (scale * LOG2E)
+        s, t = _scores_base2(q, kblk, scale, softcap)
         p = None
         if causal:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
@@ -668,15 +659,7 @@ def _fwd_kernel_btd(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             q = q_all[:, lo:hi]
             kblk = k_all[:, lo:hi]
             vblk = v_all[:, lo:hi]
-            s = jax.lax.dot_general(
-                q, kblk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            # base-2 scores (LOG2E note at the top of the file)
-            if softcap is not None:
-                s = (softcap * LOG2E) * jnp.tanh(s * (scale / softcap))
-            else:
-                s = s * (scale * LOG2E)
+            s, _ = _scores_base2(q, kblk, scale, softcap)
             if masked:
                 # wipe-by-underflow invariant holds exactly as in
                 # _fwd_kernel (q_offset is always 0 here: every q row owns
@@ -761,16 +744,7 @@ def _dq_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             do = do_all[:, lo:hi]
             lse = lse_ref[0, sh] * LOG2E  # natural -> base-2
             delta = delta_ref[0, sh]
-            s = jax.lax.dot_general(
-                q, kblk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            # base-2 scores; unmasked tanh kept for the derivative factor
-            if softcap is not None:
-                t = jnp.tanh(s * (scale / softcap))
-                s = (softcap * LOG2E) * t
-            else:
-                s = s * (scale * LOG2E)
+            s, t = _scores_base2(q, kblk, scale, softcap)
             if masked:
                 s = jnp.where(ok, s, NEG_INF)
                 p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
@@ -848,16 +822,7 @@ def _dkv_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             do = do_all[:, lo:hi]
             lse = lse_ref[0, sh] * LOG2E  # natural -> base-2
             delta = delta_ref[0, sh]
-            s = jax.lax.dot_general(
-                q, kblk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            # base-2 scores; unmasked tanh kept for the derivative factor
-            if softcap is not None:
-                t = jnp.tanh(s * (scale / softcap))
-                s = (softcap * LOG2E) * t
-            else:
-                s = s * (scale * LOG2E)
+            s, t = _scores_base2(q, kblk, scale, softcap)
             if masked:
                 s = jnp.where(ok, s, NEG_INF)
                 p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
@@ -929,20 +894,28 @@ def _flash_fwd_btd(q, k, v, h, scale, block, window=None, softcap=None):
     io_spec = pl.BlockSpec((1, block, pack * hd),
                            lambda bb, hh, i, j: (bb, i, hh))
     kv_spec = pl.BlockSpec((1, block, pack * hd), kv_idx)
+    # lse layout note (round-5, measured): a (B, H, T, 1) fp32 buffer pads
+    # 128x under TPU T(8,128) tiling (trailing singleton -> 128 lanes) —
+    # 384 MB of address space per layer at b64, the allocation behind the
+    # historic batch>=64 compile failures (tools/exp_b64.py). A dense
+    # (B, H, nq, 8, 128) per-q-block plane layout was built and reverted:
+    # the (rows, 128) <-> (block, 1) relayout it needs inside the kernels
+    # lowers to an unsupported Mosaic gather ("Only 2D gather is
+    # supported"), in both the fwd write and bwd read directions. The
+    # padding is address space, not DMA traffic (the kernel only writes
+    # real lanes), batch is throughput-saturated by 32 on a v5e, and b64
+    # runs with remat — so the padded layout stands until Mosaic grows the
+    # relayout.
+    lse_shape = jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32)
+    lse_spec = pl.BlockSpec((1, pack, block, 1),
+                            lambda bb, hh, i, j: (bb, hh, i, 0))
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_btd, scale=scale, block=block, hd=hd,
                           pack=pack, window=window, softcap=softcap),
         grid=grid,
         in_specs=[io_spec, kv_spec, kv_spec],
-        out_specs=[
-            io_spec,
-            pl.BlockSpec((1, pack, block, 1),
-                         lambda bb, hh, i, j: (bb, hh, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b, t, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, t, 1), jnp.float32),
-        ],
+        out_specs=[io_spec, lse_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, t, d), q.dtype), lse_shape],
         scratch_shapes=[
             pltpu.VMEM((pack, block, 1), jnp.float32),
             pltpu.VMEM((pack, block, 1), jnp.float32),
@@ -963,9 +936,11 @@ def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
     hd = d // h
     pack = _btd_pack(h, hd)
     nb = t // block
-    # delta = rowsum(out * do) per head: (B, T, H) -> (B, H, T, 1). The
-    # transpose is on a (B, H, T) fp32 vector — trivial next to the (B, T,
-    # D) activation transposes this path exists to kill.
+    # delta = rowsum(out * do) per head: (B, T, H) -> the lse's layout
+    # (tiled (B, H, T//128, 128) plane or (B, H, T, 1) — see
+    # _flash_fwd_btd). The transpose is on a (B, H, T) fp32 vector —
+    # trivial next to the (B, T, D) activation transposes this path exists
+    # to kill.
     delta = jnp.sum(
         out.astype(jnp.float32).reshape(b, t, h, hd)
         * do.astype(jnp.float32).reshape(b, t, h, hd), axis=-1)
