@@ -488,6 +488,10 @@ def inner() -> int:
             and os.environ.get("FLASH_LAYOUT", "auto") != "bh")
         else "bh"
     )
+    # honor an ambient FLASH_FUSED_BWD=1 (then the whole ladder measures
+    # fused and the probe below is skipped) — the record must describe
+    # how the headline was actually measured
+    flash_fused_bwd = os.environ.get("FLASH_FUSED_BWD") == "1"
     if "flash" in results:
         # one bounded extra compile: layer-scan unroll at the winning batch
         # (lets XLA fuse across layer boundaries); only meaningful when the
@@ -560,6 +564,29 @@ def inner() -> int:
                 os.environ["FLASH_LAYOUT"] = "bh"  # for extras below
                 print(f"flash layout=bh: steps/sec={r[1]:.3f} (kept)",
                       file=sys.stderr)
+        # fused-backward probe: the dq+dk+dv single-pass kernel is opt-in
+        # until chip-validated (interpret-mode parity only — see
+        # _flash_bwd_btd's gate note); one bounded compile turns it on
+        # only when it compiles AND wins on THIS backend
+        if flash_layout == "btd" and not flash_fused_bwd:
+            os.environ["FLASH_FUSED_BWD"] = "1"
+            keep_fused = False
+            try:
+                r = bench_attention(
+                    "flash", batches=(results["flash"][0],),
+                    scan_unroll=unrolls["flash"], remat=remats["flash"],
+                    unroll_layers=layer_unrolls["flash"],
+                    loss_chunks=ce_chunks["flash"],
+                )
+                keep_fused = r is not None and r[1] > results["flash"][1]
+            finally:
+                if keep_fused:
+                    results["flash"] = r
+                    flash_fused_bwd = True
+                    print(f"flash fused_bwd: steps/sec={r[1]:.3f} (kept)",
+                          file=sys.stderr)
+                else:
+                    os.environ.pop("FLASH_FUSED_BWD", None)
 
     if not results:
         print(json.dumps(_error_record("all attention paths failed or OOMed")))
@@ -632,6 +659,7 @@ def inner() -> int:
             "loss_chunks": ce_chunks.get(best, 8),
             "flash_block": flash_block,  # None = default ladder
             "flash_layout": flash_layout if best == "flash" else None,
+            "flash_fused_bwd": flash_fused_bwd if best == "flash" else None,
             "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
             "flops_per_token": fpt,
             "achieved_tflops": round(tokens_per_sec * fpt / 1e12, 2),

@@ -52,14 +52,25 @@ def init_cache(cfg: GPTConfig, batch: int, dtype=None) -> Cache:
 def _cached_block(
     x: jax.Array,            # (B, T, D) — T = prompt length or 1
     blk: gpt.Params,         # one layer's params (no leading L axis)
-    cache_kv: Tuple[jax.Array, jax.Array],  # (B, S, KV, hd) each
+    cache: Cache,            # FULL (L, B, S, KV, hd) buffers, updated here
+    layer: int,
     offset: jax.Array,       # scalar: absolute position of x[:, 0]
     cfg: GPTConfig,
-) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
-    """One pre-LN block reading/writing the KV cache; returns (y, new_kv)."""
+) -> Tuple[jax.Array, Cache]:
+    """One pre-LN block; writes this call's (B, T, KV, hd) k/v into the
+    full cache at (layer, :, offset) and attends against the layer's
+    slice. Returns (y, cache).
+
+    The update is a small dynamic_update_slice on the big buffer — XLA
+    aliases it in place through the unrolled layer chain and the decode
+    scan carry. The original layer ``lax.scan`` instead emitted every
+    layer's updated cache as stacked ys, rewriting the ENTIRE cache every
+    decode step — one-token decode scaled with cache size (~5.6 ms/token
+    at gpt2-124M b8, the r4/r5 decode mystery) instead of with the
+    one-slot update.
+    """
     b, t, _ = x.shape
     nh, kv, hd = cfg.n_head, cfg.kv_heads, cfg.head_dim
-    ck, cv = cache_kv
 
     h = gpt._norm(x, blk["ln1_scale"], blk.get("ln1_bias"), cfg)
     q = L.dense(h, blk["wq"], blk.get("bq")).reshape(b, t, nh, hd)
@@ -72,13 +83,19 @@ def _cached_block(
         q = attn_ops.apply_rope(q, cos, sin)
         k = attn_ops.apply_rope(k, cos, sin)
 
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, offset, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, offset, 0, 0))
+    big_k = jax.lax.dynamic_update_slice(
+        cache["k"], k.astype(cache["k"].dtype)[None],
+        (layer, 0, offset, 0, 0))
+    big_v = jax.lax.dynamic_update_slice(
+        cache["v"], v.astype(cache["v"].dtype)[None],
+        (layer, 0, offset, 0, 0))
+    cache = {"k": big_k, "v": big_v}
     # attend against the whole cache; kv_offset makes query absolute
     # positions correct, and the causal mask kills both future tokens and
     # never-written (zero) slots beyond offset+t
     att = attn_ops.causal_attention(
-        q, ck, cv, kv_offset=offset, window=cfg.attention_window,
+        q, big_k[layer], big_v[layer], kv_offset=offset,
+        window=cfg.attention_window,
         logit_softcap=cfg.attn_logit_softcap,
     ).reshape(b, t, nh * hd)
     att = L.dense(att, blk["wo"], blk.get("bo"))
@@ -98,14 +115,19 @@ def _cached_block(
     else:
         m = L.mlp_gelu(h2, blk["w_fc"], blk.get("b_fc"), blk["w_proj"],
                        blk.get("b_proj"))
-    return x + m, (ck, cv)
+    return x + m, cache
 
 
 def _forward_cached(
     params: gpt.Params, tokens: jax.Array, cache: Cache, offset, cfg: GPTConfig
 ) -> Tuple[jax.Array, Cache]:
     """Forward (B, T) tokens at absolute position ``offset`` through all
-    layers, reading+writing the cache. Returns (last-position logits, cache)."""
+    layers, reading+writing the cache. Returns (last-position logits, cache).
+
+    The layer loop is a static python loop (n_layer is static, decode
+    bodies are small) so each layer's cache update stays a one-slot
+    in-place write — see _cached_block.
+    """
     b, t = tokens.shape
     compute_dtype = jnp.dtype(cfg.dtype)
     x = params["wte"][tokens]
@@ -114,15 +136,10 @@ def _forward_cached(
         x = x + jnp.take(params["wpe"], pos, axis=0)
     x = x.astype(compute_dtype)
 
-    def body(carry, scanned):
-        xc = carry
-        blk, ck, cv = scanned
-        y, (ck, cv) = _cached_block(xc, blk, (ck, cv), offset, cfg)
-        return y, (ck, cv)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"])
-    )
+    for layer in range(cfg.n_layer):
+        blk = jax.tree.map(lambda a, _l=layer: a[_l], params["blocks"])
+        x, cache = _cached_block(x, blk, cache, layer, offset, cfg)
+    new_k, new_v = cache["k"], cache["v"]
     x = gpt._norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)
     w_head = params["wte"].T if cfg.tie_weights else params["head"]
     logits = jnp.einsum(

@@ -25,7 +25,8 @@ Forward: grid (B*H, T/B, T/B) with the k-block index innermost; emits the
 log-sum-exp per row for the backward.
 Backward: two kernels — dq streams K/V blocks per q block; dk/dv streams
 Q/dO blocks per k block — both recomputing probabilities from the saved LSE.
-No stored attention matrix anywhere.
+No stored attention matrix anywhere. The native-layout path fuses the two
+into one dq+dk+dv kernel when its dq scratch fits VMEM (_dqkv_kernel_btd).
 
 Falls back to the einsum oracle when the shape/config doesn't fit the kernel
 (attention dropout on, decode-time cross lengths, T not a multiple of the
@@ -163,15 +164,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    if causal and window is not None:
-        active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
-            kj >= _kv_lo(qi, block, window, q_offset))
-    else:
-        active = (kj <= _kv_hi(qi, block, q_offset, nk)) if causal \
-            else (kj >= 0)
-
-    @pl.when(active)
-    def _compute():
+    def _compute(masked):
         # matmul inputs stay in the storage dtype (bf16 on the hot path) —
         # the MXU runs bf16 x bf16 -> fp32 at full rate where fp32 x fp32
         # costs several passes; accumulation is fp32 via
@@ -180,7 +173,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         kblk = k_ref[0]  # (BK, hd)
         vblk = v_ref[0]
         s, _ = _scores_base2(q, kblk, scale, softcap)  # (BQ, BK)
-        if causal:
+        if masked:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
@@ -218,6 +211,34 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    # Full-cell specialisation (round-5, as in the btd kernels): a causal
+    # cell whose every (q, k) pair satisfies q_pos >= k_pos — min q_pos at
+    # or past max k_pos, which generalises "strictly below the diagonal"
+    # to the ring's q_offset hops — needs no iota/mask/where. Banded
+    # attention keeps the masked body everywhere (band edges cross cells).
+    if causal and window is not None:
+        active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
+            kj >= _kv_lo(qi, block, window, q_offset))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    elif causal:
+        active = kj <= _kv_hi(qi, block, q_offset, nk)
+        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
+
+        @pl.when(active & ~cell_full)
+        def _diag():
+            _compute(True)
+
+        @pl.when(active & cell_full)
+        def _full():
+            _compute(False)
+    else:
+        @pl.when(kj >= 0)
+        def _nc():
+            _compute(False)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -312,15 +333,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    if causal and window is not None:
-        active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
-            kj >= _kv_lo(qi, block, window, q_offset))
-    else:
-        active = (kj <= _kv_hi(qi, block, q_offset, nk)) if causal \
-            else (kj >= 0)
-
-    @pl.when(active)
-    def _compute():
+    def _compute(masked):
         # bf16 matmul inputs + fp32 accumulate (see _fwd_kernel note);
         # p/ds are computed in fp32 and cast back only to feed the MXU
         q = q_ref[0]
@@ -330,8 +343,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         kblk = k_ref[0]
         vblk = v_ref[0]
         s, t = _scores_base2(q, kblk, scale, softcap)
-        p = None
-        if causal:
+        if masked:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
@@ -344,7 +356,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             # (q_offset > 0, no live key) has lse ~= NEG_INF, making
             # exp2(NEG_INF - lse) = exp2(~0) = 1 garbage rather than 0
             p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
-        if p is None:
+        else:
+            # full cells contain no dead rows (every key is live for every
+            # row), so lse is finite and p needs no structural mask
             p = jnp.exp2(s - lse)
         dp = jax.lax.dot_general(
             do, vblk, (((1,), (1,)), ((), ())),
@@ -358,6 +372,30 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    # full-cell specialisation — see _fwd_kernel
+    if causal and window is not None:
+        active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
+            kj >= _kv_lo(qi, block, window, q_offset))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    elif causal:
+        active = kj <= _kv_hi(qi, block, q_offset, nk)
+        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
+
+        @pl.when(active & ~cell_full)
+        def _diag():
+            _compute(True)
+
+        @pl.when(active & cell_full)
+        def _full():
+            _compute(False)
+    else:
+        @pl.when(kj >= 0)
+        def _nc():
+            _compute(False)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -376,17 +414,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    # causal: only q blocks at or below the (offset) diagonal see this k
-    # block; a sliding window also bounds how far below
-    if causal and window is not None:
-        active = (qi >= _q_lo(kj, block, q_offset)) & (
-            qi <= _q_hi(kj, block, window, q_offset))
-    else:
-        active = (qi >= _q_lo(kj, block, q_offset)) if causal \
-            else (qi >= 0)
-
-    @pl.when(active)
-    def _compute():
+    def _compute(masked):
         # bf16 matmul inputs + fp32 accumulate (see _fwd_kernel note)
         kblk = k_ref[0]  # (BK, hd)
         vblk = v_ref[0]
@@ -395,8 +423,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse = lse_ref[0] * LOG2E  # natural -> base-2
         delta = delta_ref[0]
         s, t = _scores_base2(q, kblk, scale, softcap)
-        p = None
-        if causal:
+        if masked:
             q_pos = q_offset + qi * block + jax.lax.broadcasted_iota(
                 jnp.int32, (block, block), 0)
             k_pos = kj * block + jax.lax.broadcasted_iota(
@@ -407,8 +434,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(ok, s, NEG_INF)
             # structural masking — see _dq_kernel's dead-row note
             p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
-        if p is None:
-            p = jnp.exp2(s - lse)  # (BQ, BK)
+        else:
+            p = jnp.exp2(s - lse)  # (BQ, BK); no dead rows in full cells
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -425,6 +452,32 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+
+    # full-cell specialisation — see _fwd_kernel. causal: only q blocks at
+    # or below the (offset) diagonal see this k block; a sliding window
+    # also bounds how far below.
+    if causal and window is not None:
+        active = (qi >= _q_lo(kj, block, q_offset)) & (
+            qi <= _q_hi(kj, block, window, q_offset))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    elif causal:
+        active = qi >= _q_lo(kj, block, q_offset)
+        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
+
+        @pl.when(active & ~cell_full)
+        def _diag():
+            _compute(True)
+
+        @pl.when(active & cell_full)
+        def _full():
+            _compute(False)
+    else:
+        @pl.when(qi >= 0)
+        def _nc():
+            _compute(False)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -875,6 +928,154 @@ def _dkv_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 [dv_scr[i] for i in range(pack)], axis=1).astype(dv_ref.dtype)
 
 
+def _dqkv_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dq_ref, dk_ref, dv_ref, dq_all_scr, dk_scr, dv_scr,
+                     *, scale, block, hd, pack, window=None, softcap=None):
+    """FUSED backward: dq + dk + dv in ONE pass over the (kj, qi) grid.
+
+    The split dq / dkv kernels each recompute s, p and dp per active cell
+    — 7 matmuls and 2 full VPU softmax chains per cell across the two
+    passes, plus double DMA of every q/k/v/do block. Sharing them costs 5
+    matmuls and ONE chain: measured on-chip (round 5), the backward is
+    VPU-bound at hd=64, so this is the dominant remaining lever.
+
+    Mechanics: grid (B, H/pack, kj, qi) with qi innermost (the dkv
+    ordering). dk/dv accumulate per kj in scratch exactly as before. dq
+    accumulates across the OUTER kj sweeps into a (nq, pack, block, hd)
+    scratch slab indexed by qi — a dynamic index on the leading
+    (untiled) dim, plain address arithmetic (unlike the sublane-dim
+    dynamic stores Mosaic rejects). Every qi slab is complete by the last
+    kj sweep, which writes it out; the dq out-spec index map parks on
+    block 0 until that sweep so the buffer stays resident and is flushed
+    exactly once per q block with real contents.
+    """
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    nq = pl.num_programs(3)
+    nk = pl.num_programs(2)
+
+    @pl.when((kj == 0) & (qi == 0))
+    def _init_dq_all():
+        dq_all_scr[...] = jnp.zeros_like(dq_all_scr)
+
+    @pl.when(qi == 0)
+    def _init_kv():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    def _compute(masked):
+        q_all = q_ref[0]
+        k_all = k_ref[0]
+        v_all = v_ref[0]
+        do_all = do_ref[0]
+        if masked:
+            q_pos = qi * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 0)
+            k_pos = kj * block + jax.lax.broadcasted_iota(
+                jnp.int32, (block, block), 1)
+            ok = q_pos >= k_pos
+            if window is not None:
+                ok = ok & (q_pos - k_pos < window)
+        for sh in range(pack):
+            lo, hi = sh * hd, (sh + 1) * hd
+            q = q_all[:, lo:hi]
+            kblk = k_all[:, lo:hi]
+            vblk = v_all[:, lo:hi]
+            do = do_all[:, lo:hi]
+            lse = lse_ref[0, sh] * LOG2E  # natural -> base-2
+            delta = delta_ref[0, sh]
+            s, t = _scores_base2(q, kblk, scale, softcap)
+            if masked:
+                s = jnp.where(ok, s, NEG_INF)
+                p = jnp.where(ok, jnp.exp2(s - lse), 0.0)
+            else:
+                p = jnp.exp2(s - lse)
+            dv_scr[sh] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do, vblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - delta.astype(jnp.float32))
+            if softcap is not None:
+                ds = ds * (1.0 - t * t)
+            ds = ds * scale
+            dk_scr[sh] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dq_all_scr[qi, sh] += jax.lax.dot_general(
+                ds.astype(kblk.dtype), kblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    # diagonal-block specialisation — see _fwd_kernel_btd
+    if window is not None:
+        active = (qi >= _q_lo(kj, block, 0)) & (
+            qi <= _q_hi(kj, block, window, 0))
+
+        @pl.when(active)
+        def _m():
+            _compute(True)
+    else:
+        @pl.when(qi == kj)
+        def _diag():
+            _compute(True)
+
+        @pl.when(qi > kj)
+        def _full():
+            _compute(False)
+
+    @pl.when(kj == nk - 1)
+    def _emit_dq():
+        slab = dq_all_scr[qi]  # (pack, block, hd)
+        if pack == 1:
+            dq_ref[0] = slab[0].astype(dq_ref.dtype)
+        else:
+            dq_ref[0] = jnp.concatenate(
+                [slab[i] for i in range(pack)], axis=1).astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _finalize_kv():
+        if pack == 1:
+            dk_ref[0] = dk_scr[0].astype(dk_ref.dtype)
+            dv_ref[0] = dv_scr[0].astype(dv_ref.dtype)
+        else:
+            dk_ref[0] = jnp.concatenate(
+                [dk_scr[i] for i in range(pack)], axis=1).astype(dk_ref.dtype)
+            dv_ref[0] = jnp.concatenate(
+                [dv_scr[i] for i in range(pack)], axis=1).astype(dv_ref.dtype)
+
+
+def _btd_dkv_specs(block, pack, hd, nb, window):
+    """Shared BlockSpecs for the (kj, qi)-ordered backward grids: fixed
+    k/v blocks per kj, q/do and lse/delta streamed per qi with the band
+    clamp — ONE definition for the split dkv kernel and the fused
+    dq+dk+dv kernel, so the clamp math cannot diverge."""
+    kv_fixed = pl.BlockSpec((1, block, pack * hd),
+                            lambda bb, hh, j, i: (bb, j, hh))
+    if window is not None:
+        def _q_idx(bb, hh, j, i):
+            return (bb, jnp.clip(jnp.clip(
+                i, _q_lo(j, block, 0), _q_hi(j, block, window, 0)),
+                0, nb - 1), hh)
+
+        def _vec_idx(bb, hh, j, i):
+            return (bb, hh, jnp.clip(jnp.clip(
+                i, _q_lo(j, block, 0), _q_hi(j, block, window, 0)),
+                0, nb - 1), 0)
+    else:
+        def _q_idx(bb, hh, j, i):
+            return (bb, jnp.maximum(i, _q_lo(j, block, 0)), hh)
+
+        def _vec_idx(bb, hh, j, i):
+            return (bb, hh, jnp.maximum(i, _q_lo(j, block, 0)), 0)
+    return (kv_fixed, pl.BlockSpec((1, block, pack * hd), _q_idx),
+            pl.BlockSpec((1, pack, block, 1), _vec_idx))
+
+
 def _flash_fwd_btd(q, k, v, h, scale, block, window=None, softcap=None):
     """q/k/v (B, T, H*hd) -> out (B, T, H*hd), lse (B, H, T, 1) fp32."""
     b, t, d = q.shape
@@ -946,6 +1147,20 @@ def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
         * do.astype(jnp.float32).reshape(b, t, h, hd), axis=-1)
     delta = delta.transpose(0, 2, 1)[..., None]
 
+    # fused dq+dk+dv kernel (see _dqkv_kernel_btd) whenever its
+    # (nq, pack, block, hd) dq scratch stays within a VMEM budget —
+    # covers every shipped block_size. OPT-IN (FLASH_FUSED_BWD=1) until
+    # validated on real silicon: it is parity-tested in interpret mode,
+    # but its dynamic leading-dim scratch indexing has not met Mosaic yet
+    # (the r5 tiled-lse layout died on exactly that class of gap), and the
+    # tunnel dropped before the A/B could run. bench.py probes it and
+    # keeps it only when it compiles AND wins.
+    fused = (nb * pack * block * hd * 4 <= 4 * 2**20
+             and os.environ.get("FLASH_FUSED_BWD", "0") == "1")
+    if fused:
+        return _flash_bwd_btd_fused(q, k, v, do, lse, delta, b, t, hd,
+                                    pack, nb, scale, block, window, softcap)
+
     grid = (b, h // pack, nb, nb)
     io_q = pl.BlockSpec((1, block, pack * hd),
                         lambda bb, hh, i, j: (bb, i, hh))
@@ -976,26 +1191,8 @@ def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)[0]
 
-    kv_fixed = pl.BlockSpec((1, block, pack * hd),
-                            lambda bb, hh, j, i: (bb, j, hh))
-    if window is not None:
-        def _q_idx(bb, hh, j, i):
-            return (bb, jnp.clip(jnp.clip(
-                i, _q_lo(j, block, 0), _q_hi(j, block, window, 0)),
-                0, nb - 1), hh)
-
-        def _vec_idx(bb, hh, j, i):
-            return (bb, hh, jnp.clip(jnp.clip(
-                i, _q_lo(j, block, 0), _q_hi(j, block, window, 0)),
-                0, nb - 1), 0)
-    else:
-        def _q_idx(bb, hh, j, i):
-            return (bb, jnp.maximum(i, _q_lo(j, block, 0)), hh)
-
-        def _vec_idx(bb, hh, j, i):
-            return (bb, hh, jnp.maximum(i, _q_lo(j, block, 0)), 0)
-    q_stream = pl.BlockSpec((1, block, pack * hd), _q_idx)
-    vec_stream = pl.BlockSpec((1, pack, block, 1), _vec_idx)
+    kv_fixed, q_stream, vec_stream = _btd_dkv_specs(
+        block, pack, hd, nb, window)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel_btd, scale=scale, block=block, hd=hd,
                           pack=pack, window=window, softcap=softcap),
@@ -1009,6 +1206,42 @@ def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
                         pltpu.VMEM((pack, block, hd), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+def _flash_bwd_btd_fused(q, k, v, do, lse, delta, b, t, hd, pack, nb,
+                         scale, block, window, softcap):
+    """One fused pallas_call for dq+dk+dv — see _dqkv_kernel_btd."""
+    d = q.shape[2]
+    grid = (b, d // (pack * hd), nb, nb)
+    kv_fixed, q_stream, vec_stream = _btd_dkv_specs(
+        block, pack, hd, nb, window)
+    # dq out: park on block 0 until the last kj sweep (when every qi slab
+    # is complete) so the buffer is flushed exactly once per q block with
+    # real contents — see _dqkv_kernel_btd's docstring
+    dq_spec = pl.BlockSpec(
+        (1, block, pack * hd),
+        lambda bb, hh, j, i: (bb, jnp.where(j == nb - 1, i, 0), hh))
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_dqkv_kernel_btd, scale=scale, block=block,
+                          hd=hd, pack=pack, window=window, softcap=softcap),
+        grid=grid,
+        in_specs=[q_stream, kv_fixed, kv_fixed, q_stream, vec_stream,
+                  vec_stream],
+        out_specs=[dq_spec, kv_fixed, kv_fixed],
+        out_shape=[jax.ShapeDtypeStruct((b, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, t, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((nb, pack, block, hd), jnp.float32),
+                        pltpu.VMEM((pack, block, hd), jnp.float32),
+                        pltpu.VMEM((pack, block, hd), jnp.float32)],
+        # kj and qi share the dq scratch slab and the parked dq out block:
+        # a megacore split over either would break that residency
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)

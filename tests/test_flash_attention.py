@@ -297,3 +297,41 @@ def test_btd_gqa_grad_parity(monkeypatch):
             np.asarray(got_g), np.asarray(want_g), rtol=2e-4, atol=2e-4,
             err_msg=f"d{name} mismatch (btd gqa)",
         )
+
+
+def test_btd_fused_backward_parity(monkeypatch):
+    """The fused dq+dk+dv kernel (FLASH_FUSED_BWD=1, opt-in until
+    chip-validated) must match the split kernels AND the oracle — plain
+    causal, then window+softcap (every masked-cell branch).
+
+    FLASH_BLOCK=128 forces nb=2 at t=256: without it the whole fused
+    machinery under test — the cross-kj dq slab accumulation, the parked
+    dq out-spec flush, and the full-cell qi>kj branch — never runs (a
+    single-block grid has one diagonal cell and nothing to accumulate
+    across)."""
+    monkeypatch.setenv("FLASH_LAYOUT", "auto")
+    monkeypatch.setenv("FLASH_BLOCK", "128")
+
+    for kw in ({}, dict(window=40, logit_softcap=30.0)):
+        q, k, v = qkv(t=256, seed=29)
+
+        def loss(fn, q, k, v):
+            return jnp.sum(jnp.square(fn(q, k, v, **kw)))
+
+        monkeypatch.setenv("FLASH_FUSED_BWD", "1")
+        g_fused = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                           argnums=(0, 1, 2))(q, k, v)
+        monkeypatch.setenv("FLASH_FUSED_BWD", "0")
+        g_split = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                           argnums=(0, 1, 2))(q, k, v)
+        g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                          argnums=(0, 1, 2))(q, k, v)
+        for want, fused, split, name in zip(g_want, g_fused, g_split, "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(fused), np.asarray(want), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} fused-vs-oracle mismatch ({kw})",
+            )
+            np.testing.assert_allclose(
+                np.asarray(fused), np.asarray(split), rtol=1e-6, atol=1e-6,
+                err_msg=f"d{name} fused-vs-split mismatch ({kw})",
+            )
