@@ -482,16 +482,25 @@ def inner() -> int:
     _pcfg = GPTConfig.make(model_type=model)
     from mingpt_distributed_tpu.ops import flash_attention as _fa
 
+    # mirror causal_attention's dispatch exactly: direct pack OR the
+    # odd-head zero-padding route (hd divides 128) both land on btd
+    _hd = _pcfg.head_dim
+    _btd_applies = (
+        _fa._btd_pack(_pcfg.n_head, _hd) is not None
+        or (_hd < 128 and 128 % _hd == 0)
+    )
     flash_layout = (
         "btd"
-        if (_fa._btd_pack(_pcfg.n_head, _pcfg.head_dim) is not None
+        if (_btd_applies
             and os.environ.get("FLASH_LAYOUT", "auto") != "bh")
         else "bh"
     )
     # honor an ambient FLASH_FUSED_BWD=1 (then the whole ladder measures
     # fused and the probe below is skipped) — the record must describe
-    # how the headline was actually measured
-    flash_fused_bwd = os.environ.get("FLASH_FUSED_BWD") == "1"
+    # how the headline was actually measured. The flag only acts on the
+    # btd path, so it is only recorded there.
+    flash_fused_bwd = (flash_layout == "btd"
+                       and os.environ.get("FLASH_FUSED_BWD") == "1")
     if "flash" in results:
         # one bounded extra compile: layer-scan unroll at the winning batch
         # (lets XLA fuse across layer boundaries); only meaningful when the

@@ -125,6 +125,36 @@ def _block_sizes(t: int) -> Optional[int]:
 # ---------------------------------------------------------------------------
 
 
+def _dispatch_cells(compute, qi, kj, block, active, *, causal, window,
+                    q_offset=0):
+    """Route one grid cell to ``compute(masked)`` — the ONE definition of
+    the masked/full cell classification for all six kernels. Under a
+    window every active cell keeps the masked body (band edges cross
+    cells); plain causal splits active cells into the masked diagonal
+    and the mask-free interior (min q_pos at or past max k_pos, which
+    generalises "strictly below the diagonal" to the ring's q_offset
+    hops — full cells also cannot hold dead rows, so their p needs no
+    structural mask); non-causal is always mask-free."""
+    if causal and window is not None:
+        @pl.when(active)
+        def _m():
+            compute(True)
+    elif causal:
+        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
+
+        @pl.when(active & ~cell_full)
+        def _diag():
+            compute(True)
+
+        @pl.when(active & cell_full)
+        def _full():
+            compute(False)
+    else:
+        @pl.when(active)
+        def _nc():
+            compute(False)
+
+
 def _kv_lo(qi, block, window, q_offset=0):
     """First k block a banded-causal q block attends (window in tokens).
 
@@ -212,33 +242,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             preferred_element_type=jnp.float32,
         )
 
-    # Full-cell specialisation (round-5, as in the btd kernels): a causal
-    # cell whose every (q, k) pair satisfies q_pos >= k_pos — min q_pos at
-    # or past max k_pos, which generalises "strictly below the diagonal"
-    # to the ring's q_offset hops — needs no iota/mask/where. Banded
-    # attention keeps the masked body everywhere (band edges cross cells).
     if causal and window is not None:
         active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
             kj >= _kv_lo(qi, block, window, q_offset))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     elif causal:
         active = kj <= _kv_hi(qi, block, q_offset, nk)
-        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
-
-        @pl.when(active & ~cell_full)
-        def _diag():
-            _compute(True)
-
-        @pl.when(active & cell_full)
-        def _full():
-            _compute(False)
     else:
-        @pl.when(kj >= 0)
-        def _nc():
-            _compute(False)
+        active = kj >= 0
+    _dispatch_cells(_compute, qi, kj, block, active, causal=causal,
+                    window=window, q_offset=q_offset)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -373,29 +385,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         )
 
-    # full-cell specialisation — see _fwd_kernel
     if causal and window is not None:
         active = (kj <= _kv_hi(qi, block, q_offset, nk)) & (
             kj >= _kv_lo(qi, block, window, q_offset))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     elif causal:
         active = kj <= _kv_hi(qi, block, q_offset, nk)
-        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
-
-        @pl.when(active & ~cell_full)
-        def _diag():
-            _compute(True)
-
-        @pl.when(active & cell_full)
-        def _full():
-            _compute(False)
     else:
-        @pl.when(kj >= 0)
-        def _nc():
-            _compute(False)
+        active = kj >= 0
+    _dispatch_cells(_compute, qi, kj, block, active, causal=causal,
+                    window=window, q_offset=q_offset)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -453,31 +451,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         )
 
-    # full-cell specialisation — see _fwd_kernel. causal: only q blocks at
-    # or below the (offset) diagonal see this k block; a sliding window
-    # also bounds how far below.
+    # causal: only q blocks at or below the (offset) diagonal see this k
+    # block; a sliding window also bounds how far below
     if causal and window is not None:
         active = (qi >= _q_lo(kj, block, q_offset)) & (
             qi <= _q_hi(kj, block, window, q_offset))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     elif causal:
         active = qi >= _q_lo(kj, block, q_offset)
-        cell_full = (q_offset + qi * block) >= (kj + 1) * block - 1
-
-        @pl.when(active & ~cell_full)
-        def _diag():
-            _compute(True)
-
-        @pl.when(active & cell_full)
-        def _full():
-            _compute(False)
     else:
-        @pl.when(qi >= 0)
-        def _nc():
-            _compute(False)
+        active = qi >= 0
+    _dispatch_cells(_compute, qi, kj, block, active, causal=causal,
+                    window=window, q_offset=q_offset)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -729,26 +713,15 @@ def _fwd_kernel_btd(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
                 preferred_element_type=jnp.float32,
             )
 
-    # Diagonal-block specialisation (round-5): with square block tiling and
-    # no window, every active cell strictly below the diagonal is FULLY
-    # visible — no iota/compare/where per score, a large cut in a kernel
-    # that is VPU-bound, not MXU-bound, at hd=64. Banded attention keeps
-    # the generic masked body on every active cell (band edges cross it).
+    # full/masked cell routing shared with every kernel (_dispatch_cells)
+    # — a large cut in a kernel that is VPU-bound, not MXU-bound, at hd=64
     if window is not None:
         active = (kj <= _kv_hi(qi, block, 0, nk)) & (
             kj >= _kv_lo(qi, block, window, 0))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     else:
-        @pl.when(kj == qi)
-        def _diag():
-            _compute(True)
-
-        @pl.when(kj < qi)
-        def _full():
-            _compute(False)
+        active = kj <= _kv_hi(qi, block, 0, nk)
+    _dispatch_cells(_compute, qi, kj, block, active, causal=True,
+                    window=window)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -816,22 +789,13 @@ def _dq_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                 preferred_element_type=jnp.float32,
             )
 
-    # diagonal-block specialisation — see _fwd_kernel_btd
     if window is not None:
         active = (kj <= _kv_hi(qi, block, 0, nk)) & (
             kj >= _kv_lo(qi, block, window, 0))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     else:
-        @pl.when(kj == qi)
-        def _diag():
-            _compute(True)
-
-        @pl.when(kj < qi)
-        def _full():
-            _compute(False)
+        active = kj <= _kv_hi(qi, block, 0, nk)
+    _dispatch_cells(_compute, qi, kj, block, active, causal=True,
+                    window=window)
 
     @pl.when(kj == nk - 1)
     def _finalize():
@@ -898,23 +862,15 @@ def _dkv_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32,
             )
 
-    # diagonal-block specialisation — see _fwd_kernel_btd (here the grid
-    # streams q per k block, so the fully-visible cells are qi > kj)
+    # here the grid streams q per k block: active means qi at or below
+    # the diagonal
     if window is not None:
         active = (qi >= _q_lo(kj, block, 0)) & (
             qi <= _q_hi(kj, block, window, 0))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     else:
-        @pl.when(qi == kj)
-        def _diag():
-            _compute(True)
-
-        @pl.when(qi > kj)
-        def _full():
-            _compute(False)
+        active = qi >= _q_lo(kj, block, 0)
+    _dispatch_cells(_compute, qi, kj, block, active, causal=True,
+                    window=window)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -1011,22 +967,13 @@ def _dqkv_kernel_btd(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32,
             )
 
-    # diagonal-block specialisation — see _fwd_kernel_btd
     if window is not None:
         active = (qi >= _q_lo(kj, block, 0)) & (
             qi <= _q_hi(kj, block, window, 0))
-
-        @pl.when(active)
-        def _m():
-            _compute(True)
     else:
-        @pl.when(qi == kj)
-        def _diag():
-            _compute(True)
-
-        @pl.when(qi > kj)
-        def _full():
-            _compute(False)
+        active = qi >= _q_lo(kj, block, 0)
+    _dispatch_cells(_compute, qi, kj, block, active, causal=True,
+                    window=window)
 
     @pl.when(kj == nk - 1)
     def _emit_dq():
@@ -1329,11 +1276,29 @@ def causal_attention(
     # the hood, so the reshape below is free where to_bh pays two real
     # transposes per call (the round-4 trace's biggest remaining sink).
     # FLASH_LAYOUT=bh forces the transpose path (bench A/B escape hatch).
-    if (_btd_pack(h, hd) is not None
-            and os.environ.get("FLASH_LAYOUT", "auto") != "bh"):
-        out2 = _flash_btd(q.reshape(b, t, h * hd), k.reshape(b, t, h * hd),
-                          v.reshape(b, t, h * hd), h, scale, block, win, cap)
-        return out2.reshape(b, t, h, hd)
+    if os.environ.get("FLASH_LAYOUT", "auto") != "bh":
+        if _btd_pack(h, hd) is not None:
+            out2 = _flash_btd(
+                q.reshape(b, t, h * hd), k.reshape(b, t, h * hd),
+                v.reshape(b, t, h * hd), h, scale, block, win, cap)
+            return out2.reshape(b, t, h, hd)
+        if hd < 128 and 128 % hd == 0:
+            # Odd head counts (gpt2-xl's 25) can't pair sub-heads evenly;
+            # pad with zero heads up to the pack unit and slice the
+            # result. A zero head attends uniformly over zero values —
+            # finite lse, zero output and zero gradients, all discarded
+            # by the slice (its VJP zero-pads the cotangent). Costs
+            # (hp-h)/h extra kernel work (4% at h=25) against the two
+            # transposes saved.
+            unit = 128 // hd
+            hp = -(-h // unit) * unit
+            zpad = jnp.zeros((b, t, (hp - h) * hd), q.dtype)
+            out2 = _flash_btd(
+                jnp.concatenate([q.reshape(b, t, h * hd), zpad], axis=-1),
+                jnp.concatenate([k.reshape(b, t, h * hd), zpad], axis=-1),
+                jnp.concatenate([v.reshape(b, t, h * hd), zpad], axis=-1),
+                hp, scale, block, win, cap)
+            return out2[..., :h * hd].reshape(b, t, h, hd)
     # (B, T, H, hd) -> (B*H, T, hd)
     to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, hd)
     out = _flash(to_bh(q), to_bh(k), to_bh(v), scale, block, win, cap)

@@ -335,3 +335,27 @@ def test_btd_fused_backward_parity(monkeypatch):
                 np.asarray(fused), np.asarray(split), rtol=1e-6, atol=1e-6,
                 err_msg=f"d{name} fused-vs-split mismatch ({kw})",
             )
+
+
+def test_btd_odd_head_count_pads(monkeypatch):
+    """Odd H (gpt2-xl's 25 heads) takes the btd path via zero-head
+    padding: forward and all grads must still match the oracle."""
+    monkeypatch.setenv("FLASH_LAYOUT", "auto")
+    q, k, v = qkv(t=128, h=3, hd=32, seed=31)
+
+    def loss(fn, q, k, v):
+        return jnp.sum(jnp.square(fn(q, k, v)))
+
+    got = flash.causal_attention(q, k, v)
+    want = attn_ops.causal_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    g_got = jax.grad(lambda *a: loss(flash.causal_attention, *a),
+                     argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(lambda *a: loss(attn_ops.causal_attention, *a),
+                      argnums=(0, 1, 2))(q, k, v)
+    for want_g, got_g, name in zip(g_want, g_got, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(got_g), np.asarray(want_g), rtol=2e-4, atol=2e-4,
+            err_msg=f"d{name} mismatch (odd-H pad)",
+        )
