@@ -482,16 +482,9 @@ def inner() -> int:
     _pcfg = GPTConfig.make(model_type=model)
     from mingpt_distributed_tpu.ops import flash_attention as _fa
 
-    # mirror causal_attention's dispatch exactly: direct pack OR the
-    # odd-head zero-padding route (hd divides 128) both land on btd
-    _hd = _pcfg.head_dim
-    _btd_applies = (
-        _fa._btd_pack(_pcfg.n_head, _hd) is not None
-        or (_hd < 128 and 128 % _hd == 0)
-    )
     flash_layout = (
         "btd"
-        if (_btd_applies
+        if (_fa._btd_applies(_pcfg.n_head, _pcfg.head_dim)
             and os.environ.get("FLASH_LAYOUT", "auto") != "bh")
         else "bh"
     )
@@ -570,6 +563,9 @@ def inner() -> int:
             if r is not None and r[1] > results["flash"][1]:
                 results["flash"] = r
                 flash_layout = "bh"
+                # the kept measurement never ran the fused kernel (it
+                # only exists on the btd path) — don't record it
+                flash_fused_bwd = False
                 os.environ["FLASH_LAYOUT"] = "bh"  # for extras below
                 print(f"flash layout=bh: steps/sec={r[1]:.3f} (kept)",
                       file=sys.stderr)

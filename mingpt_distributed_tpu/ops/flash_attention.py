@@ -81,6 +81,14 @@ def _scores_base2(q, kblk, scale, softcap):
     return s * (scale * LOG2E), None
 
 
+def _btd_applies(h: int, hd: int) -> bool:
+    """Whether causal_attention routes (h, hd) to the native-(B,T,D)
+    kernels — directly packed, or via odd-head zero padding. bench.py
+    records its layout metadata through THIS predicate so the artifact
+    cannot drift from the real dispatch."""
+    return _btd_pack(h, hd) is not None or (hd < 128 and 128 % hd == 0)
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
@@ -1276,13 +1284,14 @@ def causal_attention(
     # the hood, so the reshape below is free where to_bh pays two real
     # transposes per call (the round-4 trace's biggest remaining sink).
     # FLASH_LAYOUT=bh forces the transpose path (bench A/B escape hatch).
-    if os.environ.get("FLASH_LAYOUT", "auto") != "bh":
+    if (os.environ.get("FLASH_LAYOUT", "auto") != "bh"
+            and _btd_applies(h, hd)):
         if _btd_pack(h, hd) is not None:
             out2 = _flash_btd(
                 q.reshape(b, t, h * hd), k.reshape(b, t, h * hd),
                 v.reshape(b, t, h * hd), h, scale, block, win, cap)
             return out2.reshape(b, t, h, hd)
-        if hd < 128 and 128 % hd == 0:
+        else:
             # Odd head counts (gpt2-xl's 25) can't pair sub-heads evenly;
             # pad with zero heads up to the pack unit and slice the
             # result. A zero head attends uniformly over zero values —

@@ -152,7 +152,8 @@ def test_default_stage_table_shape():
     spec.loader.exec_module(mod)
     stages = mod.default_stages()
     names = [s["name"] for s in stages]
-    assert names == ["chip_preflight", "bench", "bench_profile", "pjrt_smoke"]
+    assert names == ["chip_preflight", "bench", "bench_profile",
+                     "pjrt_smoke", "exp_btd_fused_ab", "exp_decode"]
     for s in stages:
         # every non-optional stage's entry script must exist in-tree
         if not s.get("optional"):

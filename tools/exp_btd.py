@@ -76,5 +76,26 @@ def main():
             print(json.dumps(rec), flush=True)
 
 
+def main_ab():
+    """Fused-vs-split backward A/B (round-5 chip validation of
+    _dqkv_kernel_btd): b32 both ways, then b16 fused. Exits non-zero when
+    NO run succeeded so the harvest stage is retried at the next contact
+    window instead of being marked permanently ok over pure error lines."""
+    ok = 0
+    for batch, fused in ((32, True), (32, False), (16, True)):
+        os.environ["FLASH_FUSED_BWD"] = "1" if fused else "0"
+        try:
+            rec = run(batch, "auto")
+            rec["fused_bwd"] = fused
+            ok += 1
+        except Exception as e:  # noqa: BLE001
+            rec = {"batch": batch, "fused_bwd": fused,
+                   "error": repr(e)[:300]}
+        print(json.dumps(rec), flush=True)
+    os.environ.pop("FLASH_FUSED_BWD", None)
+    if not ok:
+        sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    main_ab() if "--ab" in sys.argv else main()

@@ -72,13 +72,17 @@ def run(batch, cast, n_lo=32, n_hi=160):
 
 
 def main():
+    ok = 0
     for batch in (8, 32):
         for cast in (False, True):
             try:
                 rec = run(batch, cast)
+                ok += 1
             except Exception as e:  # noqa: BLE001
                 rec = {"batch": batch, "cast": cast, "error": repr(e)[:200]}
             print(json.dumps(rec), flush=True)
+    if not ok:  # all-error output must fail the harvest stage (retry)
+        sys.exit(1)
 
 
 def run_shape(batch, block_size, n_layer, n_lo=32, n_hi=96):

@@ -83,6 +83,20 @@ def default_stages() -> list[dict]:
                     "AXON_LOOPBACK_RELAY": "1"},
             "optional": True,  # binary may not be built in this checkout
         },
+        # round-5 experiment stages: validate the opt-in fused backward on
+        # real silicon and record the decode rewrite's measured throughput
+        {
+            "name": "exp_btd_fused_ab",
+            "argv": [py, os.path.join(REPO, "tools", "exp_btd.py"), "--ab"],
+            "artifact": os.path.join(REPO, "HARVEST_FUSED_AB.txt"),
+            "capture_text": True,
+        },
+        {
+            "name": "exp_decode",
+            "argv": [py, os.path.join(REPO, "tools", "exp_decode.py")],
+            "artifact": os.path.join(REPO, "HARVEST_DECODE.txt"),
+            "capture_text": True,
+        },
     ]
 
 
