@@ -494,14 +494,74 @@ def inner() -> int:
     # btd path, so it is only recorded there.
     flash_fused_bwd = (flash_layout == "btd"
                        and os.environ.get("FLASH_FUSED_BWD") == "1")
+    def try_probe(label, fn):
+        """Run an optional tuning probe; a raising probe is logged and
+        treated as a miss rather than aborting the bench and losing every
+        collected record (ADVICE r5 — bench_attention returning None is the
+        expected miss path, but nothing above guarantees it can't raise)."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001
+            msg = str(e).splitlines()[0] if str(e) else type(e).__name__
+            print(f"{label} probe raised (skipped): {msg}", file=sys.stderr)
+            return None
+
+    def fused_bwd_spot_check() -> bool:
+        """Numeric gate for FLASH_FUSED_BWD (ADVICE r5): compile-and-win is
+        not parity. Compare the fused dq/dk/dv against the two-kernel
+        reference backward on a small btd-path shape; only a match keeps
+        the flag. Runs with FLASH_FUSED_BWD=1 already in the env (the
+        caller set it); the reference pass flips it off and restores."""
+        import numpy as np
+
+        from mingpt_distributed_tpu.ops import flash_attention as fa
+
+        b, t, h, hd = 2, 256, 4, 64
+        block = fa._block_sizes(t)
+        if block is None or not fa._btd_applies(h, hd):
+            print("fused_bwd spot-check shape can't take the btd path; "
+                  "refusing the flag", file=sys.stderr)
+            return False
+        kq, kk, kv, kw = jax.random.split(jax.random.key(0), 4)
+        q = jax.random.normal(kq, (b, t, h * hd), jnp.bfloat16)
+        k = jax.random.normal(kk, (b, t, h * hd), jnp.bfloat16)
+        v = jax.random.normal(kv, (b, t, h * hd), jnp.bfloat16)
+        w = jax.random.normal(kw, (b, t, h * hd), jnp.bfloat16)
+        scale = 1.0 / (hd ** 0.5)
+
+        def loss(q, k, v):
+            out = fa._flash_btd(q, k, v, h, scale, block, None, None)
+            return jnp.sum(out.astype(jnp.float32) * w.astype(jnp.float32))
+
+        grad_fn = jax.grad(loss, argnums=(0, 1, 2))
+        fused = jax.device_get(grad_fn(q, k, v))
+        os.environ["FLASH_FUSED_BWD"] = "0"
+        try:
+            ref = jax.device_get(grad_fn(q, k, v))
+        finally:
+            os.environ["FLASH_FUSED_BWD"] = "1"
+        for name, gf, gr in zip(("dq", "dk", "dv"), fused, ref):
+            gf = np.asarray(gf, np.float32)
+            gr = np.asarray(gr, np.float32)
+            # both paths accumulate in f32 and emit bf16: anything beyond
+            # a few ulps of bf16 on the largest gradient is a real bug
+            tol = 3e-2 * max(1.0, float(np.abs(gr).max()))
+            err = float(np.abs(gf - gr).max())
+            if not np.isfinite(err) or err > tol:
+                print(f"fused_bwd spot-check FAILED on {name}: "
+                      f"max|Δ|={err:.3e} tol={tol:.3e}", file=sys.stderr)
+                return False
+        return True
+
     if "flash" in results:
         # one bounded extra compile: layer-scan unroll at the winning batch
         # (lets XLA fuse across layer boundaries); only meaningful when the
         # scan path won (the unrolled python loop has no scan to unroll)
         b_star, sps_star = results["flash"]
         if not layer_unrolls["flash"]:
-            r = bench_attention("flash", batches=(b_star,), scan_unroll=4,
-                                remat=remats["flash"])
+            r = try_probe("unroll", lambda: bench_attention(
+                "flash", batches=(b_star,), scan_unroll=4,
+                remat=remats["flash"]))
             if r is not None and r[1] > sps_star:
                 results["flash"] = r
                 unrolls["flash"] = 4
@@ -513,11 +573,11 @@ def inner() -> int:
         for blk in (256, 128):
             os.environ["FLASH_BLOCK"] = str(blk)
             try:
-                r = bench_attention(
+                r = try_probe(f"block={blk}", lambda: bench_attention(
                     "flash", batches=(results["flash"][0],),
                     scan_unroll=unrolls["flash"], remat=remats["flash"],
                     unroll_layers=layer_unrolls["flash"],
-                )
+                ))
             finally:
                 os.environ.pop("FLASH_BLOCK", None)
             if r is not None and r[1] > results["flash"][1]:
@@ -530,11 +590,11 @@ def inner() -> int:
         # CE chunk-count probe (r4 on-chip: 4 beat 8 by ~1% at batch 16 with
         # the unrolled chunk loop; larger counts lose matmul efficiency) —
         # one bounded extra compile, kept only if faster
-        r = bench_attention(
+        r = try_probe("loss_chunks=4", lambda: bench_attention(
             "flash", batches=(results["flash"][0],),
             scan_unroll=unrolls["flash"], remat=remats["flash"],
             unroll_layers=layer_unrolls["flash"], loss_chunks=4,
-        )
+        ))
         if r is not None and r[1] > results["flash"][1]:
             results["flash"] = r
             ce_chunks["flash"] = 4
@@ -549,12 +609,12 @@ def inner() -> int:
             prior_layout = os.environ.get("FLASH_LAYOUT")
             os.environ["FLASH_LAYOUT"] = "bh"
             try:
-                r = bench_attention(
+                r = try_probe("layout=bh", lambda: bench_attention(
                     "flash", batches=(results["flash"][0],),
                     scan_unroll=unrolls["flash"], remat=remats["flash"],
                     unroll_layers=layer_unrolls["flash"],
                     loss_chunks=ce_chunks["flash"],
-                )
+                ))
             finally:
                 if prior_layout is None:
                     os.environ.pop("FLASH_LAYOUT", None)
@@ -572,26 +632,33 @@ def inner() -> int:
         # fused-backward probe: the dq+dk+dv single-pass kernel is opt-in
         # until chip-validated (interpret-mode parity only — see
         # _flash_bwd_btd's gate note); one bounded compile turns it on
-        # only when it compiles AND wins on THIS backend
+        # only when it compiles, WINS on this backend, and passes the
+        # numeric spot-check against the reference backward. The keep
+        # decision runs after the probe (never inside a finally:, ADVICE
+        # r5 — a raising probe used to mutate results during exception
+        # unwind and then abort the whole bench); the env flag ends set
+        # iff the kernel is kept.
         if flash_layout == "btd" and not flash_fused_bwd:
             os.environ["FLASH_FUSED_BWD"] = "1"
-            keep_fused = False
-            try:
-                r = bench_attention(
-                    "flash", batches=(results["flash"][0],),
-                    scan_unroll=unrolls["flash"], remat=remats["flash"],
-                    unroll_layers=layer_unrolls["flash"],
-                    loss_chunks=ce_chunks["flash"],
-                )
-                keep_fused = r is not None and r[1] > results["flash"][1]
-            finally:
-                if keep_fused:
-                    results["flash"] = r
-                    flash_fused_bwd = True
-                    print(f"flash fused_bwd: steps/sec={r[1]:.3f} (kept)",
-                          file=sys.stderr)
-                else:
-                    os.environ.pop("FLASH_FUSED_BWD", None)
+            r = try_probe("fused_bwd", lambda: bench_attention(
+                "flash", batches=(results["flash"][0],),
+                scan_unroll=unrolls["flash"], remat=remats["flash"],
+                unroll_layers=layer_unrolls["flash"],
+                loss_chunks=ce_chunks["flash"],
+            ))
+            keep_fused = r is not None and r[1] > results["flash"][1]
+            if keep_fused and not try_probe("fused_bwd numeric",
+                                            fused_bwd_spot_check):
+                print("flash fused_bwd: won on speed but failed the "
+                      "numeric spot-check; discarding", file=sys.stderr)
+                keep_fused = False
+            if keep_fused:
+                results["flash"] = r
+                flash_fused_bwd = True
+                print(f"flash fused_bwd: steps/sec={r[1]:.3f} (kept)",
+                      file=sys.stderr)
+            else:
+                os.environ.pop("FLASH_FUSED_BWD", None)
 
     if not results:
         print(json.dumps(_error_record("all attention paths failed or OOMed")))

@@ -118,15 +118,26 @@ def _cached_block(
     return x + m, cache
 
 
-def _forward_cached(
+def _forward_cached_hidden(
     params: gpt.Params, tokens: jax.Array, cache: Cache, offset, cfg: GPTConfig
 ) -> Tuple[jax.Array, Cache]:
     """Forward (B, T) tokens at absolute position ``offset`` through all
-    layers, reading+writing the cache. Returns (last-position logits, cache).
+    layers, reading+writing the cache. Returns (final-norm hidden states
+    (B, T, D), cache) — the LM head is applied separately (``_head_logits``)
+    so callers that need logits at a *dynamic* position (the serving
+    prefill reads position ``prompt_len - 1`` of a padded prompt) can slice
+    the hidden states before paying the head matmul.
 
     The layer loop is a static python loop (n_layer is static, decode
     bodies are small) so each layer's cache update stays a one-slot
-    in-place write — see _cached_block.
+    in-place write — see _cached_block. Compile-time trade (ADVICE r5):
+    unrolling puts every layer's body in the HLO, so prefill+decode program
+    size and compile time grow roughly linearly with ``n_layer``. Fine at
+    gpt2-124M (12 layers); a 48-layer gpt2-xl pays ~4x the compile of a
+    scanned loop. If decode compile time ever binds for very deep configs,
+    gate this on ``n_layer`` and fall back to a lax.scan over layers —
+    accepting that the scan re-emits the whole cache per step (the r4/r5
+    ~5.6 ms/token decode regression this unrolled loop exists to kill).
     """
     b, t = tokens.shape
     compute_dtype = jnp.dtype(cfg.dtype)
@@ -139,15 +150,31 @@ def _forward_cached(
     for layer in range(cfg.n_layer):
         blk = jax.tree.map(lambda a, _l=layer: a[_l], params["blocks"])
         x, cache = _cached_block(x, blk, cache, layer, offset, cfg)
-    new_k, new_v = cache["k"], cache["v"]
     x = gpt._norm(x, params["lnf_scale"], params.get("lnf_bias"), cfg)
+    return x, cache
+
+
+def _head_logits(params: gpt.Params, x: jax.Array, cfg: GPTConfig) -> jax.Array:
+    """LM head over (B, t, D) hidden states -> (B, t, V) fp32 logits
+    (with the Gemma-2 final softcap when configured)."""
     w_head = params["wte"].T if cfg.tie_weights else params["head"]
     logits = jnp.einsum(
-        "btd,dv->btv", x[:, -1:], w_head.astype(x.dtype),
+        "btd,dv->btv", x, w_head.astype(x.dtype),
         preferred_element_type=jnp.float32,
-    )[:, 0]
-    logits = attn_ops.softcap(logits, cfg.final_logit_softcap)
-    return logits, {"k": new_k, "v": new_v}
+    )
+    return attn_ops.softcap(logits, cfg.final_logit_softcap)
+
+
+def _forward_cached(
+    params: gpt.Params, tokens: jax.Array, cache: Cache, offset, cfg: GPTConfig
+) -> Tuple[jax.Array, Cache]:
+    """Forward (B, T) tokens at position ``offset`` through all layers.
+    Returns (last-position logits (B, V), cache). Thin composition of
+    ``_forward_cached_hidden`` + ``_head_logits`` — the serving engine
+    (serving/engine.py) shares the same two pieces."""
+    x, cache = _forward_cached_hidden(params, tokens, cache, offset, cfg)
+    logits = _head_logits(params, x[:, -1:], cfg)[:, 0]
+    return logits, cache
 
 
 def _select_next(

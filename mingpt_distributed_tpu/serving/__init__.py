@@ -1,0 +1,45 @@
+"""Continuous-batching inference serving (ROADMAP north star: serve heavy
+traffic, not one prompt batch at a time).
+
+The substrate is models/generate.py's compiled prefill/decode split: a
+static-shape, slot-addressable KV cache updated in place. This package adds
+what a server needs on top of it:
+
+* ``SlotKVPool`` (kv_pool.py) — a fixed (L, S_slots, block_size, KV, hd)
+  cache where each slot holds one in-flight request, with a deterministic
+  host-side allocate/free free-list.
+* ``DecodeEngine`` (engine.py) — exactly two compiled programs, shared by
+  every request for the server's lifetime: prefill-into-slot and a
+  one-token-per-step decode over all slots (per-slot positions, masked
+  inactive slots, per-slot sampling params as traced arrays — admission
+  never recompiles).
+* ``InferenceServer`` (scheduler.py) — the continuous-batching scheduler:
+  a FIFO request queue with per-request sampling params, admission into
+  free slots at decode-step boundaries, retirement on per-request stop
+  conditions, token streaming via callbacks / request handles.
+* ``ServingMetrics`` (metrics.py) — tokens/sec, queue depth, slot
+  utilization, per-request TTFT and inter-token latency; periodic log line
+  plus a JSON summary, sharing the RateWindow plumbing of
+  training/metrics.py.
+
+Everything is CPU-testable with a tiny config (tests/test_serving.py) and
+driven end-to-end by ``serve.py`` at the repo root.
+"""
+
+from mingpt_distributed_tpu.serving.engine import DecodeEngine
+from mingpt_distributed_tpu.serving.kv_pool import SlotKVPool
+from mingpt_distributed_tpu.serving.metrics import ServingMetrics
+from mingpt_distributed_tpu.serving.scheduler import (
+    InferenceServer,
+    Request,
+    RequestHandle,
+)
+
+__all__ = [
+    "DecodeEngine",
+    "InferenceServer",
+    "Request",
+    "RequestHandle",
+    "ServingMetrics",
+    "SlotKVPool",
+]

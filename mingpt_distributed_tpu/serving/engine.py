@@ -1,0 +1,224 @@
+"""Compiled prefill / multi-slot decode for the continuous-batching server.
+
+Exactly TWO programs are compiled, once each, for the server's lifetime:
+
+1. **prefill-into-slot** — one forward over a right-padded ``(1,
+   prefill_len)`` prompt through ``generate._forward_cached_hidden`` (the
+   same unrolled cached-block chain solo ``generate()`` uses), whose
+   batch-1 cache is then written whole into the pool at a *traced* slot
+   index. Logits are read at the *traced* position ``length - 1`` before
+   the LM head, and the first token is sampled on device. Every dynamic
+   quantity (slot, prompt length, sampling params, PRNG key) is a traced
+   argument, so admitting request #100 reuses request #1's executable.
+
+2. **decode-step** — one token for every slot at once: ``vmap`` over the
+   slot axis of the same ``_forward_cached`` the solo scan uses, each lane
+   carrying its own absolute position (per-slot ``kv_offset`` and RoPE /
+   learned-position index, per-slot one-row cache write — the vmapped
+   dynamic_update_slice lowers to a one-row-per-slot scatter, NOT a
+   whole-cache rewrite). Per-slot sampling params ride as traced arrays.
+
+Padding correctness: the prompt is right-padded to ``prefill_len``. Causal
+masking means real positions never attend a pad position ahead of them,
+and a pad position's stale K/V only becomes visible at the decode step
+that first *writes* that position with a real token — so garbage is
+overwritten before it can ever be attended. Inactive slots keep decoding
+masked-out lanes into their own (dead) cache rows; admission prefill
+overwrites the slot before reuse.
+
+Sampling parity: the per-slot sampler mirrors ``generate._select_next``
+(temperature → top-k → top-p → sample/argmax) with the params as traced
+per-slot arrays instead of static python scalars — which is what keeps one
+compiled program serving mixed greedy/sampled tenants. For greedy lanes
+the filters cannot move the argmax, so a greedy request's tokens match
+solo ``generate()`` exactly (tests/test_serving.py asserts token identity).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models import generate as gen
+from mingpt_distributed_tpu.serving.kv_pool import SlotKVPool
+
+
+def _select_next_slots(
+    logits: jax.Array,      # (S, V) fp32
+    keys: jax.Array,        # (S,) typed PRNG keys
+    temps: jax.Array,       # (S,) float32
+    top_ks: jax.Array,      # (S,) int32, 0 = disabled
+    top_ps: jax.Array,      # (S,) float32, >= 1.0 = disabled
+    do_sample: jax.Array,   # (S,) bool
+) -> jax.Array:
+    """generate._select_next with per-slot traced params. Filter order and
+    edge semantics (top token always survives top-p; top_k clamped to V)
+    match the solo sampler exactly."""
+    v = logits.shape[-1]
+    logits = logits / jnp.maximum(temps, 1e-8)[:, None]
+    # top-k with per-slot k: threshold at the k-th largest value; k=V is a
+    # no-op, so "disabled" rides as k_eff = V
+    k_eff = jnp.where(top_ks > 0, jnp.minimum(top_ks, v), v)
+    desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    kth = jnp.take_along_axis(desc, (k_eff - 1)[:, None], axis=-1)
+    logits = jnp.where(logits < kth, -jnp.inf, logits)
+    # nucleus: smallest prefix of the (re-sorted, post-top-k) distribution
+    # whose preceding cumulative mass is < top_p; top token unconditional
+    desc2 = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc2, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = (cum - probs) < top_ps[:, None]
+    keep = keep.at[:, 0].set(True)
+    kth2 = jnp.min(jnp.where(keep, desc2, jnp.inf), axis=-1, keepdims=True)
+    nucleus_on = (top_ps < 1.0)[:, None]
+    logits = jnp.where(nucleus_on & (logits < kth2), -jnp.inf, logits)
+    sampled = jax.vmap(lambda l, k: jax.random.categorical(k, l))(logits, keys)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(do_sample, sampled, greedy).astype(jnp.int32)
+
+
+def _prefill_impl(
+    params, cache, prompt, length, slot, temp, top_k, top_p, do_sample, key,
+    *, cfg: GPTConfig,
+):
+    """prompt: (prefill_len,) right-padded; length/slot traced scalars.
+    Returns (first sampled token (scalar int32), updated pool cache)."""
+    scratch = gen.init_cache(cfg, 1, dtype=cache["k"].dtype)
+    x, scratch = gen._forward_cached_hidden(params, prompt[None], scratch, 0, cfg)
+    h_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
+    logits = gen._head_logits(params, h_last, cfg)[:, 0]  # (1, V)
+    first = _select_next_slots(
+        logits, key[None], temp[None], top_k[None], top_p[None],
+        do_sample[None],
+    )[0]
+    # the scratch cache covers the slot's FULL length (zeros past the
+    # prompt), so installing it evicts every byte of the previous tenant
+    cache = {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], scratch["k"], (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], scratch["v"], (0, slot, 0, 0, 0)),
+    }
+    return first, cache
+
+
+def _decode_impl(
+    params, cache, tokens, positions, temps, top_ks, top_ps, do_sample, keys,
+    *, cfg: GPTConfig,
+):
+    """One token for every slot: tokens/positions (S,), sampling arrays
+    (S,), keys (S,). Returns (next tokens (S,), updated pool cache)."""
+    safe_pos = jnp.clip(positions, 0, cfg.block_size - 1)
+
+    def one_slot(tok, cache_slot, pos):
+        # re-grow the batch axis the vmap stripped so the lane is exactly
+        # solo generate's (B=1, T=1) decode body
+        cache_b = jax.tree.map(lambda a: a[:, None], cache_slot)
+        logits, cache_b = gen._forward_cached(
+            params, tok[None, None], cache_b, pos, cfg)
+        return logits[0], jax.tree.map(lambda a: a[:, 0], cache_b)
+
+    logits, cache = jax.vmap(one_slot, in_axes=(0, 1, 0), out_axes=(0, 1))(
+        tokens, cache, safe_pos)
+    nxt = _select_next_slots(logits, keys, temps, top_ks, top_ps, do_sample)
+    return nxt, cache
+
+
+class DecodeEngine:
+    """Owns the slot pool and the two jitted programs.
+
+    The jit wrappers are per-engine objects so their compile caches count
+    only this engine's traces — ``compile_counts()`` is how the tests
+    assert the no-recompile-after-warmup guarantee.
+    """
+
+    def __init__(
+        self,
+        params,
+        cfg: GPTConfig,
+        n_slots: int,
+        prefill_len: Optional[int] = None,
+        cache_dtype=None,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.prefill_len = int(prefill_len or cfg.block_size)
+        if not (1 <= self.prefill_len <= cfg.block_size):
+            raise ValueError(
+                f"prefill_len {self.prefill_len} outside [1, "
+                f"{cfg.block_size}]"
+            )
+        self.pool = SlotKVPool(cfg, n_slots, cache_dtype)
+        self._prefill_jit = jax.jit(
+            functools.partial(_prefill_impl, cfg=cfg), donate_argnums=(1,))
+        self._decode_jit = jax.jit(
+            functools.partial(_decode_impl, cfg=cfg), donate_argnums=(1,))
+
+    @property
+    def n_slots(self) -> int:
+        return self.pool.n_slots
+
+    def prefill(
+        self,
+        slot: int,
+        prompt_ids: Sequence[int],
+        temperature: float,
+        top_k: Optional[int],
+        top_p: Optional[float],
+        do_sample: bool,
+        key: jax.Array,
+    ) -> int:
+        """Prefill ``prompt_ids`` (length <= prefill_len) into ``slot`` and
+        return the first sampled/greedy token."""
+        n = len(prompt_ids)
+        if not (1 <= n <= self.prefill_len):
+            raise ValueError(
+                f"prompt length {n} outside [1, {self.prefill_len}] "
+                "(the scheduler crops before calling)"
+            )
+        prompt = np.zeros(self.prefill_len, np.int32)
+        prompt[:n] = np.asarray(prompt_ids, np.int32)
+        first, cache = self._prefill_jit(
+            self.params, self.pool.cache, jnp.asarray(prompt),
+            np.int32(n), np.int32(slot),
+            np.float32(temperature),
+            np.int32(0 if top_k is None else top_k),
+            np.float32(1.0 if top_p is None else top_p),
+            np.bool_(do_sample), key,
+        )
+        self.pool.cache = cache
+        return int(jax.device_get(first))
+
+    def decode_step(
+        self,
+        tokens: np.ndarray,
+        positions: np.ndarray,
+        temps: np.ndarray,
+        top_ks: np.ndarray,
+        top_ps: np.ndarray,
+        do_sample: np.ndarray,
+        keys: jax.Array,
+    ) -> np.ndarray:
+        """Advance every slot one token; caller masks inactive lanes."""
+        nxt, cache = self._decode_jit(
+            self.params, self.pool.cache,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(positions, jnp.int32),
+            jnp.asarray(temps, jnp.float32), jnp.asarray(top_ks, jnp.int32),
+            jnp.asarray(top_ps, jnp.float32), jnp.asarray(do_sample),
+            keys,
+        )
+        self.pool.cache = cache
+        return np.asarray(jax.device_get(nxt))
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Number of distinct traces compiled per program — stays at 1 each
+        after warmup no matter how many requests are served."""
+        return {
+            "prefill": self._prefill_jit._cache_size(),
+            "decode": self._decode_jit._cache_size(),
+        }

@@ -1,0 +1,64 @@
+"""Slot-based KV-cache pool for continuous batching.
+
+One fixed ``(n_layer, n_slots, block_size, kv_heads, head_dim)`` pair of
+K/V buffers — ``models/generate.init_cache`` with the batch axis
+reinterpreted as a *slot* axis. Each slot holds one in-flight request's
+cache; a request is admitted by prefilling its prompt into a free slot
+(which overwrites the slot's full length, so stale K/V from the previous
+tenant can never leak into attention) and retired by returning the slot to
+the free list. The buffers themselves never change shape or owner-visible
+identity, which is what lets the decode program stay compiled once for the
+server's lifetime.
+
+Allocation is deterministic (lowest free index first) so a given arrival
+order always produces the same slot placement — the scheduler tests rely
+on replayability.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models.generate import Cache, init_cache
+
+
+class SlotKVPool:
+    """Fixed-slot KV cache + host-side free-list.
+
+    The device arrays live in ``.cache`` and are *replaced* (never resized)
+    by the engine after each compiled call — jit donation makes the update
+    in place at the buffer level while this object keeps a stable handle.
+    """
+
+    def __init__(self, cfg: GPTConfig, n_slots: int, dtype=None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        self.cfg = cfg
+        self.n_slots = n_slots
+        self.cache: Cache = init_cache(cfg, n_slots, dtype)
+        self._free: List[int] = list(range(n_slots))  # kept sorted
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def allocate(self) -> Optional[int]:
+        """Claim the lowest free slot index, or None when exhausted."""
+        if not self._free:
+            return None
+        return self._free.pop(0)
+
+    def free(self, slot: int) -> None:
+        """Return a slot to the pool (idempotence is a bug: double-free
+        means two requests would share a cache slot, so it raises)."""
+        if not (0 <= slot < self.n_slots):
+            raise ValueError(f"slot {slot} outside [0, {self.n_slots})")
+        if slot in self._free:
+            raise ValueError(f"slot {slot} is already free (double free)")
+        self._free.append(slot)
+        self._free.sort()

@@ -1,0 +1,130 @@
+"""Serving observability, in the style of training/metrics.py.
+
+Counters (requests submitted/completed, prefills, tokens generated),
+per-step gauges (queue depth, slot utilization), and per-request latency
+(time-to-first-token, mean inter-token latency). Tokens/sec is computed
+over log windows with the same ``RateWindow`` the training MetricsLogger
+uses, so the two subsystems report rates with identical semantics.
+
+Output surfaces: a periodic one-line log (``log_every`` scheduler steps,
+process-stdout, same pipe-separated shape as the trainer's step line) and
+an on-demand JSON summary (``summary()`` / ``write_json()``) for offline
+batch runs and the serve.py ``--selftest`` gate.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+from mingpt_distributed_tpu.training.metrics import RateWindow
+
+
+class ServingMetrics:
+    def __init__(self, n_slots: int, log_every: int = 0, enabled: bool = True):
+        self.n_slots = max(n_slots, 1)
+        self.log_every = log_every
+        self.enabled = enabled
+        # counters
+        self.requests_submitted = 0
+        self.requests_completed = 0
+        self.prefills = 0
+        self.tokens_generated = 0
+        self.steps = 0
+        # latency accumulators (seconds)
+        self._ttft_sum = 0.0
+        self._ttft_count = 0
+        self._itl_sum = 0.0
+        self._itl_count = 0
+        # gauges sampled at step boundaries
+        self.queue_depth = 0
+        self.slots_active = 0
+        self._util_sum = 0.0
+        self._rate = RateWindow()
+        self._tokens_per_sec: Optional[float] = None
+
+    # -- event hooks (called by the scheduler) -------------------------
+    def on_submit(self) -> None:
+        self.requests_submitted += 1
+
+    def on_prefill(self, ttft_s: float) -> None:
+        self.prefills += 1
+        self._ttft_sum += ttft_s
+        self._ttft_count += 1
+
+    def on_tokens(self, n: int) -> None:
+        self.tokens_generated += n
+
+    def on_complete(self, n_generated: int, gen_span_s: float) -> None:
+        """gen_span_s: first-token to last-token wall time."""
+        self.requests_completed += 1
+        if n_generated > 1:
+            self._itl_sum += gen_span_s / (n_generated - 1)
+            self._itl_count += 1
+
+    def on_step(
+        self, queue_depth: int, slots_active: int, lanes_used: Optional[int] = None
+    ) -> None:
+        """queue_depth/slots_active: end-of-round gauges (occupancy after
+        retirement). lanes_used: slots that actually decoded this step —
+        what utilization of the shared decode batch means."""
+        self.steps += 1
+        self.queue_depth = queue_depth
+        self.slots_active = slots_active
+        used = slots_active if lanes_used is None else lanes_used
+        self._util_sum += used / self.n_slots
+        rate = self._rate.observe(self.tokens_generated)
+        if rate is not None:
+            self._tokens_per_sec = rate
+        if self.enabled and self.log_every and self.steps % self.log_every == 0:
+            print(self.log_line(), flush=True)
+
+    # -- read-out ------------------------------------------------------
+    @property
+    def ttft_mean_s(self) -> Optional[float]:
+        return self._ttft_sum / self._ttft_count if self._ttft_count else None
+
+    @property
+    def itl_mean_s(self) -> Optional[float]:
+        return self._itl_sum / self._itl_count if self._itl_count else None
+
+    @property
+    def slot_utilization(self) -> Optional[float]:
+        return self._util_sum / self.steps if self.steps else None
+
+    def log_line(self) -> str:
+        parts = [
+            f"serve step {self.steps}",
+            f"active {self.slots_active}/{self.n_slots}",
+            f"queued {self.queue_depth}",
+            f"done {self.requests_completed}/{self.requests_submitted}",
+            f"tokens {self.tokens_generated}",
+        ]
+        if self._tokens_per_sec is not None:
+            parts.append(f"tokens/sec {self._tokens_per_sec:.4g}")
+        if self.ttft_mean_s is not None:
+            parts.append(f"ttft_ms {self.ttft_mean_s * 1e3:.4g}")
+        if self.itl_mean_s is not None:
+            parts.append(f"itl_ms {self.itl_mean_s * 1e3:.4g}")
+        return " | ".join(parts)
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "requests_submitted": self.requests_submitted,
+            "requests_completed": self.requests_completed,
+            "prefills": self.prefills,
+            "tokens_generated": self.tokens_generated,
+            "steps": self.steps,
+            "queue_depth": self.queue_depth,
+            "slots_active": self.slots_active,
+            "slot_utilization": self.slot_utilization,
+            "tokens_per_sec": self._tokens_per_sec,
+            "ttft_mean_s": self.ttft_mean_s,
+            "itl_mean_s": self.itl_mean_s,
+        }
+
+    def write_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.summary(), f, indent=2)
+            f.write("\n")

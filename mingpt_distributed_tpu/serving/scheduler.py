@@ -1,0 +1,254 @@
+"""Continuous-batching scheduler: queue → slots → decode-step boundaries.
+
+The loop the server runs (``step()`` = one scheduling round):
+
+1. **Admit** — while the queue is non-empty and the pool has a free slot,
+   pop FIFO, prefill the prompt into the slot (one compiled call, traced
+   slot index), sample the request's first token, start streaming.
+2. **Decode** — one shared compiled step advances *every* slot one token
+   (per-slot positions and sampling params; inactive lanes compute into
+   their own dead cache rows and are ignored host-side).
+3. **Retire** — requests hitting a stop condition (per-request
+   ``max_new_tokens`` or EOS token) finish, free their slot, and the next
+   round's admissions reuse it. Mid-decode admission is the whole point:
+   new prompts join while others are half-way through decoding.
+
+Determinism: FIFO admission, lowest-free-slot placement, and per-request
+PRNG keys derived as ``fold_in(key(seed), token_index)`` — a sampled
+request's output depends only on (params, prompt, sampling params, seed),
+never on which other requests share the batch. Greedy requests are
+token-identical to solo ``generate()`` on the same prompt (asserted in
+tests/test_serving.py).
+
+Prompt bounds: prompts longer than ``prefill_len`` are cropped to their
+last ``prefill_len`` tokens (the server has no sliding-window decode path
+— unlike solo ``generate()``'s overflow semantics, positions restart at 0
+for the cropped prompt), and ``max_new_tokens`` is clamped so decode
+positions never leave the ``block_size`` window.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.serving.engine import DecodeEngine
+from mingpt_distributed_tpu.serving.metrics import ServingMetrics
+
+
+@dataclass
+class Request:
+    """One generation request with its own sampling + stop parameters
+    (the per-request analogue of generate()'s keyword surface)."""
+
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    do_sample: bool = False
+    eos_id: Optional[int] = None   # stop when this token is produced
+    seed: int = 0                  # per-request sampling PRNG seed
+    request_id: Optional[str] = None
+
+    def validate(self) -> None:
+        if len(self.prompt) < 1:
+            raise ValueError("empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+
+
+@dataclass
+class RequestHandle:
+    """Live view of a submitted request: ``tokens`` grows as the request
+    decodes; ``finished``/``finish_reason`` flip on retirement."""
+
+    request: Request
+    request_id: str
+    prompt_used: List[int]        # after cropping to prefill_len
+    max_new_effective: int        # after clamping to the block_size window
+    tokens: List[int] = field(default_factory=list)
+    finished: bool = False
+    finish_reason: Optional[str] = None  # "length" | "eos"
+    slot: Optional[int] = None
+    submit_time: float = 0.0
+    first_token_time: Optional[float] = None
+    last_token_time: Optional[float] = None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.submit_time
+
+
+class InferenceServer:
+    """Slot-scheduled continuous-batching server over a DecodeEngine."""
+
+    def __init__(
+        self,
+        params,
+        cfg: GPTConfig,
+        n_slots: int = 4,
+        prefill_len: Optional[int] = None,
+        metrics: Optional[ServingMetrics] = None,
+        on_token: Optional[Callable[[RequestHandle, int], None]] = None,
+        log_every: int = 0,
+    ):
+        self.cfg = cfg
+        self.engine = DecodeEngine(params, cfg, n_slots, prefill_len)
+        self.metrics = metrics or ServingMetrics(n_slots, log_every=log_every)
+        self.on_token = on_token
+        self.queue: Deque[RequestHandle] = deque()
+        self._slots: List[Optional[RequestHandle]] = [None] * n_slots
+        self._ids = itertools.count()
+        # per-slot decode-state arrays (host side, fed to the engine whole)
+        self._tokens = np.zeros(n_slots, np.int32)
+        self._positions = np.zeros(n_slots, np.int32)
+        self._temps = np.ones(n_slots, np.float32)
+        self._top_ks = np.zeros(n_slots, np.int32)
+        self._top_ps = np.ones(n_slots, np.float32)
+        self._do_sample = np.zeros(n_slots, bool)
+        self._keys: List[jax.Array] = [jax.random.key(0)] * n_slots
+        self._req_keys: List[Optional[jax.Array]] = [None] * n_slots
+
+    # -- submission ----------------------------------------------------
+    def submit(self, request: Request) -> RequestHandle:
+        request.validate()
+        pl = self.engine.prefill_len
+        prompt = list(request.prompt)[-pl:]
+        # decode feeds generated tokens at positions len(prompt) ..
+        # len(prompt)+n-2 (the last token is never fed), all < block_size
+        max_new = min(request.max_new_tokens,
+                      self.cfg.block_size - len(prompt) + 1)
+        handle = RequestHandle(
+            request=request,
+            request_id=request.request_id or f"req-{next(self._ids)}",
+            prompt_used=prompt,
+            max_new_effective=max_new,
+            submit_time=time.perf_counter(),
+        )
+        self.queue.append(handle)
+        self.metrics.on_submit()
+        return handle
+
+    # -- scheduling ----------------------------------------------------
+    def _check_stop(self, handle: RequestHandle, token: int) -> bool:
+        if (handle.request.eos_id is not None
+                and token == handle.request.eos_id):
+            handle.finish_reason = "eos"
+            return True
+        if len(handle.tokens) >= handle.max_new_effective:
+            handle.finish_reason = "length"
+            return True
+        return False
+
+    def _emit(self, handle: RequestHandle, token: int) -> None:
+        now = time.perf_counter()
+        if handle.first_token_time is None:
+            handle.first_token_time = now
+        handle.last_token_time = now
+        handle.tokens.append(token)
+        self.metrics.on_tokens(1)
+        if self.on_token is not None:
+            self.on_token(handle, token)
+
+    def _retire(self, handle: RequestHandle) -> None:
+        slot = handle.slot
+        assert slot is not None
+        handle.finished = True
+        handle.slot = None
+        self._slots[slot] = None
+        self._req_keys[slot] = None
+        self.engine.pool.free(slot)
+        span = (handle.last_token_time or 0.0) - (handle.first_token_time or 0.0)
+        self.metrics.on_complete(len(handle.tokens), span)
+
+    def _admit(self, handle: RequestHandle) -> None:
+        slot = self.engine.pool.allocate()
+        assert slot is not None
+        req = handle.request
+        handle.slot = slot
+        self._slots[slot] = handle
+        req_key = jax.random.key(req.seed)
+        self._req_keys[slot] = req_key
+        first = self.engine.prefill(
+            slot, handle.prompt_used,
+            req.temperature, req.top_k, req.top_p, req.do_sample,
+            jax.random.fold_in(req_key, 0),
+        )
+        self._emit(handle, first)
+        self.metrics.on_prefill(handle.ttft_s or 0.0)
+        # slot decode state: the first token is fed at position len(prompt)
+        self._tokens[slot] = first
+        self._positions[slot] = len(handle.prompt_used)
+        self._temps[slot] = req.temperature
+        self._top_ks[slot] = 0 if req.top_k is None else req.top_k
+        self._top_ps[slot] = 1.0 if req.top_p is None else req.top_p
+        self._do_sample[slot] = req.do_sample
+        if self._check_stop(handle, first):
+            self._retire(handle)
+
+    def step(self) -> bool:
+        """One scheduling round (admit → decode → retire). Returns True
+        while any request is queued or in flight."""
+        while self.queue and self.engine.pool.free_count:
+            self._admit(self.queue.popleft())
+
+        active = [s for s, h in enumerate(self._slots) if h is not None]
+        if active:
+            for s in active:
+                handle = self._slots[s]
+                self._keys[s] = jax.random.fold_in(
+                    self._req_keys[s], len(handle.tokens))
+            nxt = self.engine.decode_step(
+                self._tokens, self._positions, self._temps, self._top_ks,
+                self._top_ps, self._do_sample, jnp.stack(self._keys),
+            )
+            for s in active:
+                handle = self._slots[s]
+                token = int(nxt[s])
+                self._emit(handle, token)
+                self._tokens[s] = token
+                self._positions[s] += 1
+                if self._check_stop(handle, token):
+                    self._retire(handle)
+
+        occupied = sum(h is not None for h in self._slots)
+        self.metrics.on_step(len(self.queue), occupied, lanes_used=len(active))
+        return bool(self.queue) or occupied > 0
+
+    def run_until_drained(self, max_steps: Optional[int] = None) -> None:
+        steps = 0
+        while self.step():
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                raise RuntimeError(
+                    f"server not drained after {max_steps} steps "
+                    f"(queued={len(self.queue)}, "
+                    f"active={self.engine.pool.used_count})"
+                )
+
+    # -- offline convenience -------------------------------------------
+    def generate_batch(self, requests: Sequence[Request]) -> List[RequestHandle]:
+        """Submit everything, drain, return handles in submission order."""
+        handles = [self.submit(r) for r in requests]
+        self.run_until_drained()
+        return handles
+
+    def compile_counts(self) -> Dict[str, int]:
+        return self.engine.compile_counts()
+
+    def summary(self) -> Dict[str, Any]:
+        return self.metrics.summary()

@@ -161,3 +161,21 @@ def _abstract_to_zeros(tree: Any) -> Any:
         return x
 
     return jax.tree.map(conv, tree)
+
+
+def restore_inference_params(path: str, gpt_cfg) -> Optional[Snapshot]:
+    """Restore a train.py snapshot for inference (params only, no optimizer
+    state): the backend dispatch sample.py and serve.py share. ``.msgpack``
+    = single blob (this module); anything else = Orbax directory (a sharded
+    checkpoint is not an openable file). Returns None when no snapshot
+    exists at ``path``."""
+    from mingpt_distributed_tpu.models import gpt
+
+    params_shape = jax.eval_shape(
+        lambda k: gpt.init(k, gpt_cfg), jax.random.key(0)
+    )
+    if path.endswith(".msgpack"):
+        return load_snapshot(path, params_shape)
+    from mingpt_distributed_tpu.training import checkpoint_orbax
+
+    return checkpoint_orbax.load_snapshot(path, params_shape)

@@ -42,6 +42,31 @@ PEAK_HBM_BYTES: dict[str, float] = {
 }
 
 
+class RateWindow:
+    """Windowed rate of a monotonically increasing marker (steps, tokens).
+
+    ``observe(marker)`` returns the marker's change per second since the
+    previous call, or None on the first call / when the marker did not
+    advance. Shared plumbing between the training MetricsLogger (steps/sec
+    → tokens/sec/MFU) and the serving metrics (tokens/sec, serving/metrics
+    .py) so both report rates over the same kind of log window.
+    """
+
+    def __init__(self) -> None:
+        self._last: Optional[tuple[float, float]] = None
+
+    def observe(self, marker: float, now: Optional[float] = None) -> Optional[float]:
+        if now is None:
+            now = time.perf_counter()
+        rate = None
+        if self._last is not None:
+            last_t, last_m = self._last
+            if marker > last_m and now > last_t:
+                rate = (marker - last_m) / (now - last_t)
+        self._last = (now, marker)
+        return rate
+
+
 def _chip_lookup(table: dict[str, float]) -> Optional[float]:
     # longest-prefix-wins by dict order: "TPU v5 lite" is listed before
     # "TPU v5" in both tables, so v5e doesn't read the v5p row
@@ -102,27 +127,23 @@ class MetricsLogger:
                 self._tb = SummaryWriter(log_dir=tensorboard_dir)
             except Exception as e:  # optional dep — degrade to other sinks
                 print(f"tensorboard sink unavailable ({e}); continuing")
-        self._last_time: Optional[float] = None
-        self._last_step: Optional[int] = None
+        self._rate = RateWindow()
         self._peak = peak_flops_per_chip()
 
     def log_step(
         self, step: int, tokens_per_step: int, seq_len: int, scalars: Dict[str, Any]
     ) -> Dict[str, Any]:
-        now = time.perf_counter()
         rec: Dict[str, Any] = {"step": step}
         rec.update({k: float(v) for k, v in scalars.items()})
-        if self._last_time is not None and step > self._last_step:
-            dt = now - self._last_time
-            steps = step - self._last_step
-            tps = tokens_per_step * steps / dt
+        steps_per_sec = self._rate.observe(step)
+        if steps_per_sec is not None:
+            tps = tokens_per_step * steps_per_sec
             rec["tokens_per_sec"] = tps
             rec["tokens_per_sec_per_chip"] = tps / self.n_chips
             flops = flops_per_token(self.cfg, seq_len) * tps / self.n_chips
             rec["flops_per_chip"] = flops
             if self._peak:
                 rec["mfu"] = flops / self._peak
-        self._last_time, self._last_step = now, step
         if self.enabled:
             parts = [f"step {step}"] + [
                 f"{k} {v:.4g}" for k, v in rec.items() if k != "step"

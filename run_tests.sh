@@ -28,7 +28,17 @@ if [[ $has_m -eq 0 ]]; then
   set -- -m "not slow and not mid" "$@"
 fi
 
-exec env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python -m pytest tests/ "$@"
+
+# End-to-end serving gate: offline batch over canned prompts with a
+# random-init tiny model (no checkpoint needed) — verifies the
+# continuous-batching server produces generate()-identical greedy output
+# and never recompiles after warmup (serve.py --selftest exits non-zero
+# on any mismatch).
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest

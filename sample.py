@@ -42,7 +42,6 @@ def main(argv=None) -> int:
     from mingpt_distributed_tpu.config import load_config
     from mingpt_distributed_tpu.data.token_dataset import make_dataset
     from mingpt_distributed_tpu.models import generate as gen
-    from mingpt_distributed_tpu.models import gpt
     from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
 
     cfg = load_config(args.config, args.overrides)
@@ -58,17 +57,9 @@ def main(argv=None) -> int:
     )
 
     path = cfg.trainer_config.snapshot_path or ckpt_lib.DEFAULT_SNAPSHOT_PATH
-    params_shape = jax.eval_shape(
-        lambda k: gpt.init(k, gpt_cfg), jax.random.key(0)
-    )
-    # same backend dispatch as the trainer: .msgpack = single blob, anything
-    # else = Orbax directory (a sharded checkpoint is not an openable file)
-    if path.endswith(".msgpack"):
-        snap = ckpt_lib.load_snapshot(path, params_shape)
-    else:
-        from mingpt_distributed_tpu.training import checkpoint_orbax
-
-        snap = checkpoint_orbax.load_snapshot(path, params_shape)
+    # shared restore helper (also used by serve.py): msgpack-vs-Orbax
+    # backend dispatch by suffix, params-only
+    snap = ckpt_lib.restore_inference_params(path, gpt_cfg)
     if snap is None:
         print(f"no snapshot at {path}; train first (python train.py)",
               file=sys.stderr)

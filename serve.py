@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Continuous-batching inference server entry point.
+
+Where sample.py decodes ONE prompt per process, this CLI drives the
+serving/ subsystem: a slot-based KV scheduler over the compiled decode
+path that admits new prompts mid-decode, streams tokens per request as
+they are produced, and reports serving metrics (tokens/sec, queue depth,
+slot utilization, TTFT, inter-token latency).
+
+Modes (checkpoint restore is shared with sample.py —
+training.checkpoint.restore_inference_params):
+
+  REPL (default)      read prompts from stdin one line at a time, stream
+                      the completion as it decodes:
+                        python serve.py [--config gpt2_config.yaml]
+  offline batch       drain a file of prompts (one per line) through the
+                      scheduler concurrently, print the completions:
+                        python serve.py --prompts-file prompts.txt
+  self-test           no checkpoint needed: random-init tiny model, three
+                      canned prompts through 2 slots (forces queueing),
+                      greedy outputs verified token-identical to solo
+                      generate() and the no-recompile guarantee asserted —
+                      the CI end-to-end gate (run_tests.sh):
+                        python serve.py --selftest
+
+Common knobs: --slots N, --max-new-tokens, --temperature, --top-k,
+--top-p, --greedy, --eos-text STR (stop when the encoded token appears),
+--metrics-json PATH, --log-every N, plus section.key=value config
+overrides as in train.py/sample.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+
+
+def build_argparser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", default="gpt2_config.yaml")
+    p.add_argument("--slots", type=int, default=4)
+    p.add_argument("--max-new-tokens", type=int, default=200)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top-k", type=int, default=None)
+    p.add_argument("--top-p", type=float, default=None)
+    p.add_argument("--greedy", action="store_true",
+                   help="argmax decoding (default: sample)")
+    p.add_argument("--eos-text", default=None,
+                   help="stop a request when this (single-token) text is "
+                        "produced")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--prompts-file", default=None,
+                   help="offline batch mode: one prompt per line")
+    p.add_argument("--selftest", action="store_true",
+                   help="random-init tiny model + canned prompts; verifies "
+                        "greedy parity with generate() and exits")
+    p.add_argument("--metrics-json", default=None,
+                   help="write the serving metrics summary JSON here")
+    p.add_argument("--log-every", type=int, default=20,
+                   help="scheduler steps between metric log lines (0 = off)")
+    p.add_argument("overrides", nargs="*")
+    return p
+
+
+def _request_for(args, tokens, eos_id=None):
+    from mingpt_distributed_tpu.serving import Request
+
+    return Request(
+        prompt=tokens,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        do_sample=not args.greedy,
+        eos_id=eos_id,
+        seed=args.seed,
+    )
+
+
+def selftest(args) -> int:
+    """Offline batch over 3 canned prompts with a random-init tiny model:
+    greedy server output must be token-identical to solo generate(), with
+    both compiled programs traced exactly once. CI runs this via
+    run_tests.sh so the server is exercised end-to-end without a
+    checkpoint."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import generate as gen
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import InferenceServer, Request
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 12
+
+    server = InferenceServer(params, cfg, n_slots=2,
+                             log_every=args.log_every)
+    handles = server.generate_batch(
+        [Request(prompt=p, max_new_tokens=max_new) for p in prompts])
+
+    rc = 0
+    for text, p, h in zip(canned, prompts, handles):
+        want = np.asarray(
+            gen.generate(params, cfg, jnp.asarray(p, jnp.int32)[None],
+                         max_new))[0, len(p):].tolist()
+        ok = h.tokens == want
+        print(f"selftest {h.request_id} ({text!r}): "
+              + ("OK" if ok else f"MISMATCH server={h.tokens} solo={want}"))
+        if not ok:
+            rc = 1
+    counts = server.compile_counts()
+    if counts != {"prefill": 1, "decode": 1}:
+        print(f"selftest FAIL: recompilation after warmup: {counts}")
+        rc = 1
+    summary = server.summary()
+    print("selftest metrics:", json.dumps(summary))
+    if args.metrics_json:
+        server.metrics.write_json(args.metrics_json)
+    if summary["requests_completed"] != len(canned):
+        print("selftest FAIL: not all requests completed")
+        rc = 1
+    print("selftest", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
+def main(argv=None) -> int:
+    args = build_argparser().parse_args(argv)
+    if args.selftest:
+        return selftest(args)
+
+    import jax
+
+    from mingpt_distributed_tpu.config import load_config
+    from mingpt_distributed_tpu.data.token_dataset import make_dataset
+    from mingpt_distributed_tpu.serving import InferenceServer
+    from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
+
+    cfg = load_config(args.config, args.overrides)
+    dataset = make_dataset(cfg.data_config)
+    gpt_cfg = dataclasses.replace(
+        cfg.gpt_config,
+        vocab_size=dataset.vocab_size,
+        block_size=dataset.block_size,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+    )
+    path = cfg.trainer_config.snapshot_path or ckpt_lib.DEFAULT_SNAPSHOT_PATH
+    snap = ckpt_lib.restore_inference_params(path, gpt_cfg)
+    if snap is None:
+        print(f"no snapshot at {path}; train first (python train.py)",
+              file=sys.stderr)
+        return 1
+    params = jax.device_put(snap.params)
+    print(f"loaded snapshot step {snap.step} from {path}", file=sys.stderr)
+
+    eos_id = None
+    if args.eos_text is not None:
+        eos = dataset.encode(args.eos_text)
+        if len(eos) != 1:
+            print(f"--eos-text must encode to one token, got {len(eos)}",
+                  file=sys.stderr)
+            return 1
+        eos_id = int(eos[0])
+
+    # stream tokens as they decode: print the newly-decoded text suffix of
+    # each request (decode-accumulated-and-diff is tokenizer-agnostic)
+    printed = {}
+
+    def on_token(handle, _tok) -> None:
+        text = dataset.decode(handle.tokens)
+        sys.stdout.write(text[len(printed.get(handle.request_id, "")):])
+        printed[handle.request_id] = text
+        sys.stdout.flush()
+
+    if args.prompts_file:
+        with open(args.prompts_file) as f:
+            lines = [ln.rstrip("\n") for ln in f if ln.strip()]
+        server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
+                                 log_every=args.log_every)
+        handles = server.generate_batch(
+            [_request_for(args, dataset.encode(ln), eos_id) for ln in lines])
+        for ln, h in zip(lines, handles):
+            print(f"=== {h.request_id} ({h.finish_reason}) ===")
+            print(ln + dataset.decode(h.tokens))
+        print(json.dumps(server.summary()))
+        if args.metrics_json:
+            server.metrics.write_json(args.metrics_json)
+        return 0
+
+    # REPL: one prompt per stdin line, streamed as it decodes
+    server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
+                             on_token=on_token, log_every=0)
+    interactive = sys.stdin.isatty()
+    if interactive:
+        print("prompt> ", end="", flush=True)
+    for line in sys.stdin:
+        prompt = line.rstrip("\n")
+        if not prompt:
+            if interactive:
+                print("prompt> ", end="", flush=True)
+            continue
+        sys.stdout.write(prompt)
+        server.submit(_request_for(args, dataset.encode(prompt), eos_id))
+        server.run_until_drained()
+        print()
+        if interactive:
+            print("prompt> ", end="", flush=True)
+    if args.metrics_json:
+        server.metrics.write_json(args.metrics_json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
