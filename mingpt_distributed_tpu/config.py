@@ -394,6 +394,20 @@ class TrainerConfig:
     # .msgpack suffix). Raise deliberately if your hosts really have the
     # RAM and you want the single-blob format anyway.
     msgpack_gather_limit_mb: int = 8192
+    # --- durability (training/durability.py) -----------------------------
+    # Checkpoints retained in the commit manifest (keep-last-K rotation);
+    # older step objects are deleted after the manifest stops referencing
+    # them. >= 2 gives corruption-aware restore something to fall back to.
+    keep_snapshots: int = 3
+    # Retry budget for transient fsspec I/O around snapshot save/load
+    # (exponential backoff + jitter; missing/permanent errors never retry).
+    io_retries: int = 4
+    io_retry_delay_s: float = 0.5   # base backoff delay (0 = no sleep, tests)
+    # Install SIGTERM/SIGINT handlers in train(): request a stop at the
+    # next step boundary, snapshot, and exit requeue-friendly (the
+    # preemption contract of TPU spot/preemptible VMs). Only takes effect
+    # in the main thread; False restores the previous die-mid-step behavior.
+    handle_signals: bool = True
     # Accumulate gradients over this many micro-batches per optimizer step
     # (one lax.scan inside the same jitted step): activation memory scales
     # with batch_size/grad_accum_steps, semantics stay the full batch.

@@ -47,6 +47,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from mingpt_distributed_tpu.ops import attention as attn_ops
+from mingpt_distributed_tpu.utils import compat
 
 NEG_INF = -1e30
 
@@ -329,7 +330,7 @@ def _flash_fwd(q, k, v, scale, block, causal=True, window=None, softcap=None,
         ],
         # bh and q-block cells are independent; only the k dimension carries
         # the online-softmax state sequentially
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -525,7 +526,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
         out_specs=[q_fixed],
         out_shape=[jax.ShapeDtypeStruct((bh, t, hd), q.dtype)],
         scratch_shapes=[pltpu.VMEM((block, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -567,7 +568,7 @@ def _flash_bwd(q, k, v, out, lse, do, scale, block, causal=True, dlse=None,
             pltpu.VMEM((block, hd), jnp.float32),
             pltpu.VMEM((block, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")
         ),
         interpret=_interpret(),
@@ -1077,7 +1078,7 @@ def _flash_fwd_btd(q, k, v, h, scale, block, window=None, softcap=None):
             pltpu.VMEM((pack, block, 1), jnp.float32),
             pltpu.VMEM((pack, block, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
@@ -1140,7 +1141,7 @@ def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
         out_specs=[io_q],
         out_shape=[jax.ShapeDtypeStruct((b, t, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((pack, block, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
@@ -1159,7 +1160,7 @@ def _flash_bwd_btd(q, k, v, out, lse, do, h, scale, block, window=None,
                    jax.ShapeDtypeStruct((b, t, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((pack, block, hd), jnp.float32),
                         pltpu.VMEM((pack, block, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=_interpret(),
@@ -1195,7 +1196,7 @@ def _flash_bwd_btd_fused(q, k, v, do, lse, delta, b, t, hd, pack, nb,
                         pltpu.VMEM((pack, block, hd), jnp.float32)],
         # kj and qi share the dq scratch slab and the parked dq out block:
         # a megacore split over either would break that residency
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary",
                                  "arbitrary")),
         interpret=_interpret(),

@@ -74,6 +74,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
+from mingpt_distributed_tpu.utils import compat
 
 
 def _split_diff(tree):
@@ -309,7 +310,7 @@ def pipeline_blocks(
 
     seq_ax = "sp" if seq_sharded else None
     x_spec = P(BATCH_AXES, seq_ax, *([None] * (x.ndim - 2)))
-    fn = jax.shard_map(
+    fn = compat.shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(x_spec, xs_specs if xs_specs is not None else P("pp"), P()),

@@ -31,6 +31,7 @@ from mingpt_distributed_tpu.serving.kv_pool import SlotKVPool
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
 from mingpt_distributed_tpu.serving.scheduler import (
     InferenceServer,
+    QueueFullError,
     Request,
     RequestHandle,
 )
@@ -38,6 +39,7 @@ from mingpt_distributed_tpu.serving.scheduler import (
 __all__ = [
     "DecodeEngine",
     "InferenceServer",
+    "QueueFullError",
     "Request",
     "RequestHandle",
     "ServingMetrics",

@@ -29,6 +29,9 @@ class ServingMetrics:
         # counters
         self.requests_submitted = 0
         self.requests_completed = 0
+        self.requests_rejected = 0   # bounded-queue submit refusals
+        self.requests_expired = 0    # deadline hits (queued or mid-decode)
+        self.requests_failed = 0     # on_token callback raised
         self.prefills = 0
         self.tokens_generated = 0
         self.steps = 0
@@ -47,6 +50,15 @@ class ServingMetrics:
     # -- event hooks (called by the scheduler) -------------------------
     def on_submit(self) -> None:
         self.requests_submitted += 1
+
+    def on_reject(self) -> None:
+        self.requests_rejected += 1
+
+    def on_expire(self) -> None:
+        self.requests_expired += 1
+
+    def on_error(self) -> None:
+        self.requests_failed += 1
 
     def on_prefill(self, ttft_s: float) -> None:
         self.prefills += 1
@@ -101,6 +113,13 @@ class ServingMetrics:
             f"done {self.requests_completed}/{self.requests_submitted}",
             f"tokens {self.tokens_generated}",
         ]
+        dropped = (self.requests_rejected + self.requests_expired
+                   + self.requests_failed)
+        if dropped:
+            parts.append(
+                f"dropped {dropped} (rej {self.requests_rejected} / exp "
+                f"{self.requests_expired} / err {self.requests_failed})"
+            )
         if self._tokens_per_sec is not None:
             parts.append(f"tokens/sec {self._tokens_per_sec:.4g}")
         if self.ttft_mean_s is not None:
@@ -113,6 +132,9 @@ class ServingMetrics:
         return {
             "requests_submitted": self.requests_submitted,
             "requests_completed": self.requests_completed,
+            "requests_rejected": self.requests_rejected,
+            "requests_expired": self.requests_expired,
+            "requests_failed": self.requests_failed,
             "prefills": self.prefills,
             "tokens_generated": self.tokens_generated,
             "steps": self.steps,

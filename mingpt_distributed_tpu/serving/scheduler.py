@@ -25,6 +25,20 @@ last ``prefill_len`` tokens (the server has no sliding-window decode path
 — unlike solo ``generate()``'s overflow semantics, positions restart at 0
 for the cropped prompt), and ``max_new_tokens`` is clamped so decode
 positions never leave the ``block_size`` window.
+
+Robustness under sustained traffic (ISSUE 2):
+
+* **bounded queue** — ``max_queue`` caps waiting requests; beyond it,
+  ``submit`` raises :class:`QueueFullError` (backpressure the caller can
+  act on) instead of growing the deque without bound;
+* **deadlines** — a per-request ``deadline_s`` (or the server-wide
+  ``default_deadline_s``) expires requests at step boundaries, whether
+  still queued or mid-decode, so an abandoned request can never pin a KV
+  slot forever (``finish_reason="deadline"``);
+* **callback isolation** — a raising ``on_token`` callback retires the
+  request and frees its slot (``finish_reason="error"``, the exception
+  on ``handle.error``) instead of leaking the slot or tearing down the
+  scheduling loop for every other tenant.
 """
 
 from __future__ import annotations
@@ -44,6 +58,11 @@ from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
 
 
+class QueueFullError(RuntimeError):
+    """submit() refused: the bounded request queue is at max depth.
+    Callers should shed load or retry later — backpressure, not OOM."""
+
+
 @dataclass
 class Request:
     """One generation request with its own sampling + stop parameters
@@ -57,6 +76,7 @@ class Request:
     do_sample: bool = False
     eos_id: Optional[int] = None   # stop when this token is produced
     seed: int = 0                  # per-request sampling PRNG seed
+    deadline_s: Optional[float] = None  # expire this long after submit
     request_id: Optional[str] = None
 
     def validate(self) -> None:
@@ -67,6 +87,9 @@ class Request:
                 f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
         if self.temperature < 0:
             raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.deadline_s is not None and self.deadline_s < 0:
+            raise ValueError(
+                f"deadline_s must be >= 0, got {self.deadline_s}")
 
 
 @dataclass
@@ -80,9 +103,11 @@ class RequestHandle:
     max_new_effective: int        # after clamping to the block_size window
     tokens: List[int] = field(default_factory=list)
     finished: bool = False
-    finish_reason: Optional[str] = None  # "length" | "eos"
+    finish_reason: Optional[str] = None  # "length" | "eos" | "deadline" | "error"
     slot: Optional[int] = None
     submit_time: float = 0.0
+    deadline: Optional[float] = None     # absolute clock time; None = never
+    error: Optional[BaseException] = None  # a raising on_token callback
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
 
@@ -105,11 +130,19 @@ class InferenceServer:
         metrics: Optional[ServingMetrics] = None,
         on_token: Optional[Callable[[RequestHandle, int], None]] = None,
         log_every: int = 0,
+        max_queue: Optional[int] = None,
+        default_deadline_s: Optional[float] = None,
+        clock: Callable[[], float] = time.perf_counter,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(params, cfg, n_slots, prefill_len)
         self.metrics = metrics or ServingMetrics(n_slots, log_every=log_every)
         self.on_token = on_token
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = max_queue
+        self.default_deadline_s = default_deadline_s
+        self.clock = clock  # injectable for deterministic deadline tests
         self.queue: Deque[RequestHandle] = deque()
         self._slots: List[Optional[RequestHandle]] = [None] * n_slots
         self._ids = itertools.count()
@@ -126,18 +159,29 @@ class InferenceServer:
     # -- submission ----------------------------------------------------
     def submit(self, request: Request) -> RequestHandle:
         request.validate()
+        if self.max_queue is not None and len(self.queue) >= self.max_queue:
+            self.metrics.on_reject()
+            raise QueueFullError(
+                f"request queue full ({len(self.queue)}/{self.max_queue} "
+                f"waiting, {self.engine.pool.used_count} decoding) — shed "
+                f"load or retry later"
+            )
         pl = self.engine.prefill_len
         prompt = list(request.prompt)[-pl:]
         # decode feeds generated tokens at positions len(prompt) ..
         # len(prompt)+n-2 (the last token is never fed), all < block_size
         max_new = min(request.max_new_tokens,
                       self.cfg.block_size - len(prompt) + 1)
+        now = self.clock()
+        deadline_s = (request.deadline_s if request.deadline_s is not None
+                      else self.default_deadline_s)
         handle = RequestHandle(
             request=request,
             request_id=request.request_id or f"req-{next(self._ids)}",
             prompt_used=prompt,
             max_new_effective=max_new,
-            submit_time=time.perf_counter(),
+            submit_time=now,
+            deadline=None if deadline_s is None else now + deadline_s,
         )
         self.queue.append(handle)
         self.metrics.on_submit()
@@ -154,26 +198,60 @@ class InferenceServer:
             return True
         return False
 
-    def _emit(self, handle: RequestHandle, token: int) -> None:
-        now = time.perf_counter()
+    def _emit(self, handle: RequestHandle, token: int) -> bool:
+        """Record a decoded token and stream it. Returns False when the
+        user's on_token callback raised — the caller must retire the
+        request (freeing its slot) instead of leaking it."""
+        now = self.clock()
         if handle.first_token_time is None:
             handle.first_token_time = now
         handle.last_token_time = now
         handle.tokens.append(token)
         self.metrics.on_tokens(1)
         if self.on_token is not None:
-            self.on_token(handle, token)
+            try:
+                self.on_token(handle, token)
+            except Exception as e:  # the callback is user code: isolate it
+                handle.error = e
+                print(
+                    f"[serve] on_token callback raised for "
+                    f"{handle.request_id}: {e!r} — retiring request, "
+                    f"freeing its slot", flush=True,
+                )
+                return False
+        return True
+
+    def _release_slot(self, handle: RequestHandle) -> None:
+        slot = handle.slot
+        if slot is not None:
+            handle.slot = None
+            self._slots[slot] = None
+            self._req_keys[slot] = None
+            self.engine.pool.free(slot)
 
     def _retire(self, handle: RequestHandle) -> None:
-        slot = handle.slot
-        assert slot is not None
+        assert handle.slot is not None
         handle.finished = True
-        handle.slot = None
-        self._slots[slot] = None
-        self._req_keys[slot] = None
-        self.engine.pool.free(slot)
+        self._release_slot(handle)
         span = (handle.last_token_time or 0.0) - (handle.first_token_time or 0.0)
         self.metrics.on_complete(len(handle.tokens), span)
+
+    def _fail(self, handle: RequestHandle, reason: str) -> None:
+        """Terminal non-success: deadline expiry (queued or mid-decode) or
+        a raising callback. Frees the slot so it can never stay pinned."""
+        handle.finished = True
+        handle.finish_reason = reason
+        self._release_slot(handle)
+        if reason == "deadline":
+            self.metrics.on_expire()
+        else:
+            self.metrics.on_error()
+
+    def _expire_if_due(self, handle: RequestHandle, now: float) -> bool:
+        if handle.deadline is not None and now >= handle.deadline:
+            self._fail(handle, "deadline")
+            return True
+        return False
 
     def _admit(self, handle: RequestHandle) -> None:
         slot = self.engine.pool.allocate()
@@ -188,7 +266,7 @@ class InferenceServer:
             req.temperature, req.top_k, req.top_p, req.do_sample,
             jax.random.fold_in(req_key, 0),
         )
-        self._emit(handle, first)
+        ok = self._emit(handle, first)
         self.metrics.on_prefill(handle.ttft_s or 0.0)
         # slot decode state: the first token is fed at position len(prompt)
         self._tokens[slot] = first
@@ -197,12 +275,25 @@ class InferenceServer:
         self._top_ks[slot] = 0 if req.top_k is None else req.top_k
         self._top_ps[slot] = 1.0 if req.top_p is None else req.top_p
         self._do_sample[slot] = req.do_sample
-        if self._check_stop(handle, first):
+        if not ok:
+            self._fail(handle, "error")
+        elif self._check_stop(handle, first):
             self._retire(handle)
 
     def step(self) -> bool:
-        """One scheduling round (admit → decode → retire). Returns True
-        while any request is queued or in flight."""
+        """One scheduling round (expire → admit → decode → retire).
+        Returns True while any request is queued or in flight."""
+        # deadline sweep first: expired queued requests never take a slot,
+        # expired in-flight requests release theirs before admission
+        now = self.clock()
+        expired_queued = [h for h in self.queue
+                          if self._expire_if_due(h, now)]
+        if expired_queued:
+            self.queue = deque(h for h in self.queue if not h.finished)
+        for h in list(self._slots):
+            if h is not None:
+                self._expire_if_due(h, now)
+
         while self.queue and self.engine.pool.free_count:
             self._admit(self.queue.popleft())
 
@@ -219,10 +310,12 @@ class InferenceServer:
             for s in active:
                 handle = self._slots[s]
                 token = int(nxt[s])
-                self._emit(handle, token)
+                ok = self._emit(handle, token)
                 self._tokens[s] = token
                 self._positions[s] += 1
-                if self._check_stop(handle, token):
+                if not ok:
+                    self._fail(handle, "error")
+                elif self._check_stop(handle, token):
                     self._retire(handle)
 
         occupied = sum(h is not None for h in self._slots)

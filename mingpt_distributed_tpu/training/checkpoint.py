@@ -15,27 +15,41 @@ scratch", the wrapper-agnostic schema. Fixed / upgraded:
 * **no pickle** — arrays go through flax.serialization msgpack (the
   reference's torch.load of an untrusted path executes pickle);
 * atomic local writes (tmp + rename) so a killed job can't leave a torn
-  snapshot behind.
+  snapshot behind;
+* **durable, crash-consistent saves** (training/durability.py): every
+  save writes a step-suffixed data object and then commits it via a small
+  JSON manifest (``<path>.manifest.json``: ``latest`` pointer +
+  per-checkpoint SHA-256 digest + step), keeping the last K checkpoints.
+  All fsspec I/O retries transient errors with exponential backoff, and
+  restore verifies the digest — falling back to the previous good
+  checkpoint on a torn/truncated/bit-flipped blob instead of crashing
+  (or loading garbage).
 
-The on-disk schema is the public contract (ModelSnapshot analogue):
+The serialised schema is the public contract (ModelSnapshot analogue):
 ``{version, step, epoch, prng, data_state, config, state: {params, opt_state}}``.
+A legacy single blob at the bare ``path`` (the pre-manifest layout) still
+restores; new saves always go through the manifest.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import json
-import os
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-import fsspec
 import jax
 import numpy as np
 from flax import serialization
 
+from mingpt_distributed_tpu.training import durability
+from mingpt_distributed_tpu.training.durability import (
+    RetryPolicy,
+    SnapshotIntegrityError,
+)
+
 SNAPSHOT_VERSION = 1
 DEFAULT_SNAPSHOT_PATH = "gpt_snapshot.msgpack"  # reference default: gpt_snapshot.pt
+DEFAULT_KEEP = 3  # checkpoints retained in the manifest (keep-last-K)
 
 
 @dataclass
@@ -57,8 +71,22 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
-def save_snapshot(path: str, snap: Snapshot) -> None:
-    """Serialise and write. Call only from the single writer (process 0)."""
+def save_snapshot(
+    path: str,
+    snap: Snapshot,
+    keep: int = DEFAULT_KEEP,
+    retry: Optional[RetryPolicy] = None,
+) -> None:
+    """Serialise and durably commit. Call only from the single writer
+    (process 0).
+
+    The write protocol (durability.commit_blob): the blob lands at a
+    step-suffixed key nothing references yet (local keys additionally use
+    tmp+rename, the reference's atomicity, now with a digest), then the
+    manifest PUT commits it. A crash or injected fault anywhere in between
+    leaves the previous manifest — and every checkpoint it points at —
+    fully intact. Transient fsspec errors retry with backoff + jitter.
+    """
     payload = {
         "version": SNAPSHOT_VERSION,
         "step": snap.step,
@@ -72,25 +100,29 @@ def save_snapshot(path: str, snap: Snapshot) -> None:
         },
     }
     blob = serialization.to_bytes(payload)
-    if "://" in path:
-        # object stores (s3://, gs://) — fsspec transport, the reference's
-        # boto3 upload path (trainer.py:93-95) generalised
-        with fsspec.open(path, "wb") as f:
-            f.write(blob)
-    else:
-        # local: atomic tmp+rename so resume never sees a torn file
-        tmp = path + ".tmp"
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)
+    durability.commit_blob(
+        path, blob, step=snap.step, epoch=snap.epoch, keep=keep, policy=retry
+    )
 
 
 def load_snapshot(
-    path: str, params_like: Any, opt_state_like: Any = None
+    path: str,
+    params_like: Any,
+    opt_state_like: Any = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Optional[Snapshot]:
     """Try to load; None = no snapshot, train from scratch (the reference's
     FileNotFoundError branch, trainer.py:103-107).
+
+    Restore path: read the manifest, walk newest → oldest, return the
+    first checkpoint whose SHA-256 matches its committed digest and whose
+    payload deserialises — a torn/truncated latest falls back to the
+    previous good checkpoint. No manifest falls back to the legacy single
+    blob at the bare ``path``. Only *missing* (durability.classify_io_error
+    — FileNotFoundError or any ENOENT-carrying OSError, regardless of
+    fsspec backend) means fresh start; transient I/O retries then raises,
+    so a blip can never be mistaken for "no snapshot" and let a later save
+    overwrite the only good state.
 
     ``params_like`` / ``opt_state_like`` supply the target pytree structure
     (fresh init or eval_shape) the serialised arrays are poured into —
@@ -98,30 +130,32 @@ def load_snapshot(
     ``opt_state_like=None`` skips optimizer state (inference-only restore);
     the returned Snapshot then has ``opt_state=None``.
     """
-    try:
-        with fsspec.open(path, "rb") as f:
-            blob = f.read()
-    except FileNotFoundError:
-        # only a *missing* snapshot means fresh start; transient I/O or
-        # permission errors must propagate, or a later save would overwrite
-        # a good snapshot with fresh-init state
-        return None
-    payload = serialization.msgpack_restore(blob)
-    if payload["version"] != SNAPSHOT_VERSION:
-        raise ValueError(
-            f"snapshot version {payload['version']} != {SNAPSHOT_VERSION}"
-        )
-    params = serialization.from_state_dict(
+    manifest = durability.load_manifest(path, retry)
+    if manifest is not None and manifest.entries:
+        blob, entry = durability.read_verified(path, manifest, retry)
+        payload = _restore_payload(blob, source=entry.key)
+    else:
+        # legacy pre-manifest layout: one blob at the bare path
+        try:
+            blob = durability.read_bytes(path, retry)
+        except BaseException as e:  # noqa: BLE001 — classified, not blanket
+            if durability.is_missing_error(e):
+                return None
+            raise
+        payload = _restore_payload(blob, source=path)
+    params = _owned(serialization.from_state_dict(
         _abstract_to_zeros(params_like), payload["state"]["params"]
-    )
+    ))
     _check_shapes(params_like, params, "params")
     opt_state = None
     if opt_state_like is not None:
-        opt_state = serialization.from_state_dict(
+        opt_state = _owned(serialization.from_state_dict(
             _abstract_to_zeros(opt_state_like), payload["state"]["opt_state"]
-        )
+        ))
         _check_shapes(opt_state_like, opt_state, "opt_state")
     prng = payload["prng"]
+    if prng is not None:
+        prng = np.array(prng)
     return Snapshot(
         params=params,
         opt_state=opt_state,
@@ -131,6 +165,36 @@ def load_snapshot(
         data_state=json.loads(payload["data_state"]) if payload["data_state"] else {},
         config=json.loads(payload["config"]) if payload["config"] else {},
     )
+
+
+def _owned(tree: Any) -> Any:
+    """Deep-copy restored leaves into memory the caller owns.
+
+    msgpack_restore hands back READ-ONLY numpy views into the serialised
+    blob. jax's CPU backend zero-copy-adopts immutable aligned numpy
+    arrays on device_put — and the trainer then DONATES the restored
+    state to the compiled step, so XLA would write into (and recycle)
+    heap memory owned by the blob's bytes object: nondeterministic
+    corruption/segfaults on resume. Owned writable copies force a real
+    device buffer and also let the (much larger) blob be GC'd instead of
+    being pinned by views."""
+    return jax.tree.map(np.array, tree)
+
+
+def _restore_payload(blob: bytes, source: str) -> dict:
+    """msgpack bytes -> payload dict, with version gate and a corruption
+    error that names the offending object."""
+    try:
+        payload = serialization.msgpack_restore(blob)
+    except Exception as e:
+        raise SnapshotIntegrityError(
+            f"snapshot blob {source} is corrupt (msgpack decode failed): {e}"
+        ) from e
+    if payload["version"] != SNAPSHOT_VERSION:
+        raise ValueError(
+            f"snapshot version {payload['version']} != {SNAPSHOT_VERSION}"
+        )
+    return payload
 
 
 def _check_shapes(expected: Any, restored: Any, label: str) -> None:

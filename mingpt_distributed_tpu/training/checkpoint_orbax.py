@@ -27,15 +27,26 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from mingpt_distributed_tpu.training import durability
 from mingpt_distributed_tpu.training.checkpoint import Snapshot
+from mingpt_distributed_tpu.training.durability import RetryPolicy
 
 
 def _abs(path: str) -> str:
     return path if "://" in path else os.path.abspath(path)
 
 
-def save_snapshot(path: str, snap: Snapshot) -> None:
-    """Collective sharded save (call from ALL processes)."""
+def save_snapshot(
+    path: str, snap: Snapshot, retry: RetryPolicy | None = None
+) -> None:
+    """Collective sharded save (call from ALL processes).
+
+    Atomicity is Orbax's own commit protocol (write to a tmp dir, final
+    rename by process 0). Transient-I/O retries apply only in
+    single-process runs: on a pod, hosts retrying a *collective* save
+    independently would desynchronise the rendezvous (one host re-enters
+    while the rest moved on) — there the error propagates and the whole
+    job requeues instead."""
     meta = {
         "step": int(snap.step),
         "epoch": int(snap.epoch),
@@ -44,17 +55,24 @@ def save_snapshot(path: str, snap: Snapshot) -> None:
         "config": snap.config,
     }
     state = {"params": snap.params, "opt_state": snap.opt_state}
-    with ocp.Checkpointer(
-        ocp.CompositeCheckpointHandler()
-    ) as ckptr:
-        ckptr.save(
-            _abs(path),
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                meta=ocp.args.JsonSave(meta),
-            ),
-            force=True,  # overwrite-in-place cadence, like the reference
-        )
+
+    def _save():
+        with ocp.Checkpointer(
+            ocp.CompositeCheckpointHandler()
+        ) as ckptr:
+            ckptr.save(
+                _abs(path),
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    meta=ocp.args.JsonSave(meta),
+                ),
+                force=True,  # overwrite-in-place cadence, like the reference
+            )
+
+    if jax.process_count() == 1:
+        durability.with_retries(_save, retry, op=f"orbax save {path}")
+    else:
+        _save()
 
 
 def load_snapshot(
@@ -62,10 +80,17 @@ def load_snapshot(
     params_like: Any,
     opt_state_like: Any = None,
     shardings: Any = None,
+    retry: Optional[RetryPolicy] = None,
 ) -> Optional[Snapshot]:
     """Collective restore. ``params_like``/``opt_state_like`` are abstract
     trees (eval_shape); ``shardings`` (same structure, {"params","opt_state"})
-    places restored arrays directly on the mesh."""
+    places restored arrays directly on the mesh.
+
+    Missing-vs-transient classification is shared with the msgpack backend
+    (durability.classify_io_error): only a genuinely missing checkpoint
+    means fresh start — fsspec/tensorstore backends that surface missing
+    objects as bare ENOENT OSErrors get the same verdict, and transient
+    errors retry with backoff instead of fresh-starting over a blip."""
     apath = _abs(path)
     if "://" not in apath and not os.path.isdir(apath):
         return None
@@ -90,17 +115,24 @@ def load_snapshot(
             opt_state_like,
             None if shardings is None else shardings["opt_state"],
         )
-    try:
+    def _restore():
         with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
-            restored = ckptr.restore(
+            return ckptr.restore(
                 apath,
                 args=ocp.args.Composite(
                     state=ocp.args.StandardRestore(abstract_state),
                     meta=ocp.args.JsonRestore(),
                 ),
             )
-    except FileNotFoundError:
-        return None
+
+    try:
+        restored = durability.with_retries(
+            _restore, retry, op=f"orbax restore {apath}"
+        )
+    except BaseException as e:  # noqa: BLE001 — classified, not blanket
+        if durability.is_missing_error(e):
+            return None
+        raise
     meta = restored["meta"]
     state = restored["state"]
     prng = meta.get("prng")

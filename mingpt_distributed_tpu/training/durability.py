@@ -1,0 +1,379 @@
+"""Durable I/O primitives for checkpointing: error classification, retry
+with exponential backoff + jitter, SHA-256 digests, and the commit
+manifest that turns a set of snapshot objects into a crash-consistent
+checkpoint history.
+
+Production TPU training treats preemption and storage flakiness as the
+steady state (PAPERS.md: "Scalable Training of Language Models using JAX
+pjit and TPUv4"). The failure modes this module is built around:
+
+* **transient I/O** — an object-store PUT/GET times out or resets; the
+  only correct reaction is backoff + retry, not killing a multi-hour run;
+* **missing object** — fsspec backends surface "no such key" as
+  ``FileNotFoundError`` *or* other ``OSError`` subclasses depending on
+  backend; missing must be classified in ONE place so "fresh start" and
+  "retry" never get confused (a transient error mistaken for missing
+  would let a later save overwrite the only good state);
+* **torn / corrupt blobs** — a writer killed mid-PUT, or a store that
+  returns truncated bytes. Every committed object carries a SHA-256
+  digest in the manifest; restore verifies before trusting.
+
+The commit protocol (``Manifest``): data objects are written under
+step-suffixed keys that nothing references yet, then a small JSON
+manifest — ``latest`` pointer + per-checkpoint digest/step — is written
+last as the single commit point. A crash between the two leaves the
+previous manifest (and every object it references) fully intact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import hashlib
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+import fsspec
+
+MANIFEST_VERSION = 1
+MANIFEST_SUFFIX = ".manifest.json"
+
+# -- error classification ---------------------------------------------------
+
+MISSING = "missing"
+TRANSIENT = "transient"
+PERMANENT = "permanent"
+
+# errno values that mean "the object is not there" rather than "the store
+# hiccuped" — ENOENT is the POSIX spelling; some fsspec backends raise a
+# bare OSError carrying it instead of FileNotFoundError.
+_MISSING_ERRNOS = {errno.ENOENT}
+# errors that retrying cannot fix: bad credentials, a directory where a
+# file was expected, read-only stores.
+_PERMANENT_OSERRORS = (
+    PermissionError,
+    IsADirectoryError,
+    NotADirectoryError,
+)
+
+
+def classify_io_error(exc: BaseException) -> str:
+    """One shared verdict for every fsspec/OS error the checkpoint layer
+    sees: ``missing`` | ``transient`` | ``permanent``.
+
+    Used by both the retry loop (retry only ``transient``) and
+    ``load_snapshot`` ("fresh start" only on ``missing``) so the two can
+    never disagree about what a given exception means.
+    """
+    if isinstance(exc, _PERMANENT_OSERRORS):
+        return PERMANENT
+    if isinstance(exc, FileNotFoundError):
+        return MISSING
+    if isinstance(exc, OSError):
+        if exc.errno in _MISSING_ERRNOS:
+            return MISSING
+        # covers TimeoutError, ConnectionError, BlockingIOError, and the
+        # anonymous OSErrors object-store backends raise on flaky transport
+        return TRANSIENT
+    return PERMANENT
+
+
+def is_missing_error(exc: BaseException) -> bool:
+    return classify_io_error(exc) == MISSING
+
+
+class SnapshotIntegrityError(RuntimeError):
+    """Every checkpoint referenced by the manifest failed digest or
+    deserialisation checks — restoring would load corrupt state, and
+    fresh-starting would let the next save overwrite the evidence."""
+
+
+# -- retry ------------------------------------------------------------------
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with deterministic-seedable jitter.
+
+    ``sleep`` is injectable so tests (and the fault harness) run with zero
+    wall-clock delay; ``seed`` pins the jitter sequence.
+    """
+
+    attempts: int = 4
+    base_delay_s: float = 0.5
+    max_delay_s: float = 8.0
+    multiplier: float = 2.0
+    jitter: float = 0.25          # fraction of the delay randomised away
+    seed: Optional[int] = None
+    sleep: Callable[[float], None] = time.sleep
+
+    def delays(self):
+        rng = random.Random(self.seed)
+        d = self.base_delay_s
+        for _ in range(max(self.attempts - 1, 0)):
+            yield d * (1.0 - self.jitter * rng.random())
+            d = min(d * self.multiplier, self.max_delay_s)
+
+
+#: zero-sleep policy for tests and the --selftest-faults smoke
+NO_WAIT = RetryPolicy(attempts=4, base_delay_s=0.0, seed=0, sleep=lambda _: None)
+
+
+def with_retries(
+    fn: Callable[[], Any],
+    policy: Optional[RetryPolicy] = None,
+    op: str = "io",
+) -> Any:
+    """Run ``fn``; retry transient failures per ``policy``.
+
+    ``missing`` and ``permanent`` errors raise immediately (retrying a 404
+    or a permission error only delays the inevitable); the last transient
+    error raises once attempts are exhausted.
+    """
+    policy = policy or RetryPolicy()
+    delays = policy.delays()
+    attempt = 1
+    while True:
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            verdict = classify_io_error(e)
+            if verdict != TRANSIENT:
+                raise
+            try:
+                delay = next(delays)
+            except StopIteration:
+                raise e
+            print(
+                f"[durability] transient {op} error "
+                f"(attempt {attempt}/{policy.attempts}): {e!r}; "
+                f"retrying in {delay:.2f}s"
+            )
+            policy.sleep(delay)
+            attempt += 1
+
+
+# -- digests ----------------------------------------------------------------
+
+
+def sha256_hex(blob: bytes) -> str:
+    return hashlib.sha256(blob).hexdigest()
+
+
+# -- byte transport (retry-wrapped, atomic where the backend allows) --------
+
+
+def _is_local(path: str) -> bool:
+    return "://" not in path
+
+
+def write_bytes(
+    path: str, blob: bytes, policy: Optional[RetryPolicy] = None
+) -> None:
+    """Write ``blob`` to ``path`` with retries.
+
+    Local paths write tmp+rename so a killed writer can never leave a torn
+    file at the final name. Remote (``://``) paths write the key directly —
+    the manifest commit protocol is what makes that safe: an uncommitted
+    key is invisible to readers.
+    """
+    if _is_local(path):
+        def put():
+            tmp = path + ".tmp"
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+    else:
+        def put():
+            fs, p = fsspec.core.url_to_fs(path)
+            with fs.open(p, "wb") as f:
+                f.write(blob)
+    with_retries(put, policy, op=f"write {path}")
+
+
+def read_bytes(path: str, policy: Optional[RetryPolicy] = None) -> bytes:
+    """Read ``path`` fully, with retries on transient errors. ``missing``
+    raises FileNotFoundError-family immediately (callers map it to their
+    own semantics — fresh start, or fall back to a previous checkpoint)."""
+    def get():
+        fs, p = fsspec.core.url_to_fs(path)
+        with fs.open(p, "rb") as f:
+            return f.read()
+    return with_retries(get, policy, op=f"read {path}")
+
+
+def delete_quiet(path: str) -> None:
+    """Best-effort delete (checkpoint rotation): never raises — a
+    leftover rotated-out object is garbage, not a correctness problem."""
+    try:
+        fs, p = fsspec.core.url_to_fs(path)
+        fs.rm(p)
+    except BaseException:  # noqa: BLE001
+        pass
+
+
+# -- the commit manifest ----------------------------------------------------
+
+
+@dataclass
+class ManifestEntry:
+    key: str          # object key, relative to the manifest's directory
+    step: int
+    epoch: int
+    sha256: str
+    size: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclass
+class Manifest:
+    """``latest`` pointer + ordered checkpoint history, committed as one
+    small JSON PUT. Entries are oldest → newest; restore walks newest →
+    oldest until a digest-verified checkpoint loads."""
+
+    entries: List[ManifestEntry] = field(default_factory=list)
+
+    @property
+    def latest(self) -> Optional[ManifestEntry]:
+        return self.entries[-1] if self.entries else None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "version": MANIFEST_VERSION,
+                "latest": self.latest.key if self.latest else None,
+                "checkpoints": [e.to_dict() for e in self.entries],
+            },
+            indent=2,
+        )
+
+    @classmethod
+    def from_json(cls, text: str) -> "Manifest":
+        raw = json.loads(text)
+        if raw.get("version") != MANIFEST_VERSION:
+            raise ValueError(
+                f"manifest version {raw.get('version')} != {MANIFEST_VERSION}"
+            )
+        return cls(
+            entries=[ManifestEntry(**e) for e in raw.get("checkpoints", [])]
+        )
+
+
+def manifest_path(snapshot_path: str) -> str:
+    return snapshot_path + MANIFEST_SUFFIX
+
+
+def object_key(snapshot_path: str, step: int) -> str:
+    """Step-suffixed data key next to ``snapshot_path`` (never the bare
+    path itself — the bare path is reserved for legacy single-blob
+    snapshots, which restore still reads)."""
+    return f"{snapshot_path}.step-{step:08d}"
+
+
+def _sibling(snapshot_path: str, key: str) -> str:
+    """Resolve a manifest-relative key next to the snapshot path."""
+    head = snapshot_path.rsplit("/", 1)[0] if "/" in snapshot_path else "."
+    return f"{head}/{key}"
+
+
+def load_manifest(
+    snapshot_path: str, policy: Optional[RetryPolicy] = None
+) -> Optional[Manifest]:
+    """None = no manifest (legacy layout or fresh run); transient errors
+    retry then raise — they must never be mistaken for 'fresh start'."""
+    try:
+        text = read_bytes(manifest_path(snapshot_path), policy)
+    except BaseException as e:  # noqa: BLE001
+        if is_missing_error(e):
+            return None
+        raise
+    return Manifest.from_json(text.decode("utf-8"))
+
+
+def commit_blob(
+    snapshot_path: str,
+    blob: bytes,
+    step: int,
+    epoch: int,
+    keep: int = 3,
+    policy: Optional[RetryPolicy] = None,
+) -> ManifestEntry:
+    """The durable-write protocol: data object first (uncommitted key),
+    manifest second (the commit point), rotation last (best effort).
+
+    Returns the committed entry. ``keep`` bounds the history; the
+    rotated-out objects are deleted only AFTER the new manifest no longer
+    references them, so no reader can race into a dangling pointer.
+    """
+    if keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep}")
+    key_path = object_key(snapshot_path, step)
+    write_bytes(key_path, blob, policy)
+
+    manifest = load_manifest(snapshot_path, policy) or Manifest()
+    entry = ManifestEntry(
+        key=key_path.rsplit("/", 1)[-1],
+        step=int(step),
+        epoch=int(epoch),
+        sha256=sha256_hex(blob),
+        size=len(blob),
+    )
+    # re-saving the same step replaces that entry (e.g. a retried run that
+    # stopped at the same boundary) instead of growing duplicate keys
+    manifest.entries = [e for e in manifest.entries if e.step != entry.step]
+    manifest.entries.append(entry)
+    dropped = manifest.entries[:-keep]
+    manifest.entries = manifest.entries[-keep:]
+    write_bytes(
+        manifest_path(snapshot_path), manifest.to_json().encode(), policy
+    )
+    for old in dropped:
+        delete_quiet(_sibling(snapshot_path, old.key))
+    return entry
+
+
+def read_verified(
+    snapshot_path: str,
+    manifest: Manifest,
+    policy: Optional[RetryPolicy] = None,
+) -> Tuple[bytes, ManifestEntry]:
+    """Walk the manifest newest → oldest; return the first blob whose
+    SHA-256 matches its committed digest. A digest-mismatched (torn,
+    truncated, bit-flipped) or unreadable blob is reported and skipped —
+    restore falls back to the previous good checkpoint instead of
+    crashing or, worse, loading garbage into the optimizer."""
+    failures = []
+    for entry in reversed(manifest.entries):
+        path = _sibling(snapshot_path, entry.key)
+        try:
+            blob = read_bytes(path, policy)
+        except BaseException as e:  # noqa: BLE001
+            if classify_io_error(e) == PERMANENT:
+                raise
+            failures.append(f"{entry.key}: unreadable ({e!r})")
+            continue
+        digest = sha256_hex(blob)
+        if digest != entry.sha256:
+            failures.append(
+                f"{entry.key}: digest mismatch "
+                f"(manifest {entry.sha256[:12]}…, got {digest[:12]}…, "
+                f"{len(blob)}/{entry.size} bytes)"
+            )
+            continue
+        if failures:
+            print(
+                "[durability] fell back to checkpoint "
+                f"step {entry.step} after: " + "; ".join(failures)
+            )
+        return blob, entry
+    raise SnapshotIntegrityError(
+        f"no checkpoint in {manifest_path(snapshot_path)} passed "
+        f"verification: " + "; ".join(failures)
+    )

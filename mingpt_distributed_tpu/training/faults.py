@@ -1,0 +1,251 @@
+"""Deterministic fault injection for fsspec I/O — the chaos harness the
+durability layer is tested (and can be manually stressed) against.
+
+``FaultInjectionFileSystem`` registers as the ``faulty://`` protocol and
+proxies every operation to a target filesystem (local by default), while
+a list of :class:`FaultSpec` rules decides which operations to sabotage:
+
+* fail the **Nth** write/read, or **every** Kth one, with a transient
+  ``OSError`` — exercises the retry + backoff path;
+* **truncate** a write (half the bytes land, the call "succeeds") —
+  exercises digest verification and previous-good fallback;
+* **delay** an operation — exercises timeout behaviour under load;
+* report a blob as **missing** — exercises missing-vs-transient
+  classification.
+
+Counters advance deterministically per matching operation (a whole-object
+open-for-write or open-for-read is one operation — the granularity of an
+object-store PUT/GET), so a given spec produces the same fault schedule
+every run. Specs come from the constructor, :meth:`set_faults`, or the
+``MINGPT_FAULTS`` environment variable, so the same machinery is a unit
+-test fixture, a ``--selftest-faults`` smoke, and a manual chaos knob for
+a real training run::
+
+    MINGPT_FAULTS="write:every=3" python train.py \\
+        trainer_config.snapshot_path=faulty:///ckpt/run1/snap.msgpack
+
+Spec grammar (semicolon-separated): ``op[:field=value]...`` with fields
+``nth`` (1-based one-shot), ``every`` (periodic), ``mode``
+(``error`` | ``truncate`` | ``delay`` | ``missing``), ``match``
+(substring filter on the path), ``delay`` (seconds, for mode=delay).
+"""
+
+from __future__ import annotations
+
+import errno
+import io
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Any, List, Optional
+
+import fsspec
+from fsspec import AbstractFileSystem
+
+ENV_VAR = "MINGPT_FAULTS"
+ENV_TARGET = "MINGPT_FAULT_TARGET"
+
+
+@dataclass
+class FaultSpec:
+    """One sabotage rule. ``count`` is the number of operations of ``op``
+    seen so far that matched ``match`` — the deterministic clock faults
+    fire against."""
+
+    op: str                       # "write" | "read"
+    nth: int = 0                  # fire on exactly this matching op (1-based)
+    every: int = 0                # fire on every k-th matching op
+    mode: str = "error"           # "error" | "truncate" | "delay" | "missing"
+    match: str = ""               # substring filter on the path
+    delay_s: float = 0.0
+    count: int = field(default=0, compare=False)
+
+    def __post_init__(self):
+        if self.op not in ("write", "read"):
+            raise ValueError(f"fault op must be write|read, got {self.op!r}")
+        if self.mode not in ("error", "truncate", "delay", "missing"):
+            raise ValueError(f"unknown fault mode {self.mode!r}")
+        if not self.nth and not self.every:
+            raise ValueError("fault spec needs nth=N or every=K")
+
+    def fires(self, op: str, path: str) -> bool:
+        """Advance the clock if (op, path) matches; True when the fault
+        should trigger on this operation."""
+        if op != self.op or (self.match and self.match not in path):
+            return False
+        self.count += 1
+        if self.nth and self.count == self.nth:
+            return True
+        if self.every and self.count % self.every == 0:
+            return True
+        return False
+
+
+def parse_faults(text: str) -> List[FaultSpec]:
+    """``"write:every=3;read:nth=2:mode=truncate"`` -> [FaultSpec, ...]."""
+    specs: List[FaultSpec] = []
+    for part in text.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        kwargs: dict = {"op": fields[0].strip()}
+        for f in fields[1:]:
+            if "=" not in f:
+                raise ValueError(f"malformed fault field {f!r} in {part!r}")
+            k, v = f.split("=", 1)
+            k = k.strip()
+            if k in ("nth", "every"):
+                kwargs[k] = int(v)
+            elif k == "delay":
+                kwargs["delay_s"] = float(v)
+            elif k in ("mode", "match"):
+                kwargs[k] = v.strip()
+            else:
+                raise ValueError(f"unknown fault field {k!r} in {part!r}")
+        specs.append(FaultSpec(**kwargs))
+    return specs
+
+
+def _injected_error(op: str, path: str) -> OSError:
+    # EIO without a FileNotFoundError subclass -> classified TRANSIENT by
+    # durability.classify_io_error, which is the point: retries must engage
+    return OSError(errno.EIO, f"injected transient {op} failure", path)
+
+
+class _FaultyWriteFile(io.BytesIO):
+    """Buffers the whole object; the fault verdict lands at close() —
+    whole-object semantics matching an object-store PUT. ``truncate``
+    writes half the bytes and reports success (silent corruption, the
+    digest check's job to catch); ``error`` writes nothing and raises."""
+
+    def __init__(self, target_fs, path: str, mode: Optional[str], delay_s: float):
+        super().__init__()
+        self._target_fs = target_fs
+        self._path = path
+        self._fault = mode
+        self._delay_s = delay_s
+        self._done = False
+
+    def close(self):
+        if self._done or self.closed:
+            return
+        self._done = True
+        blob = self.getvalue()
+        super().close()
+        if self._fault == "error":
+            raise _injected_error("write", self._path)
+        if self._fault == "delay":
+            time.sleep(self._delay_s)
+        if self._fault == "truncate":
+            blob = blob[: len(blob) // 2]
+        with self._target_fs.open(self._path, "wb") as f:
+            f.write(blob)
+
+
+class FaultInjectionFileSystem(AbstractFileSystem):
+    """fsspec filesystem that proxies ``faulty://<path>`` to a target
+    filesystem (``target_protocol``, default local) through the fault
+    rules. Instances are cached by fsspec, so counters persist across
+    ``fsspec.open`` calls — the schedule is process-global and
+    deterministic."""
+
+    protocol = "faulty"
+    cachable = True
+
+    def __init__(
+        self,
+        faults: Optional[str] = None,
+        target_protocol: Optional[str] = None,
+        target_options: Optional[dict] = None,
+        **kwargs: Any,
+    ):
+        super().__init__(**kwargs)
+        self.target = fsspec.filesystem(
+            target_protocol or os.environ.get(ENV_TARGET, "file"),
+            **(target_options or {}),
+        )
+        spec_text = faults if faults is not None else os.environ.get(ENV_VAR, "")
+        self.specs: List[FaultSpec] = parse_faults(spec_text)
+
+    # -- harness controls ----------------------------------------------
+    def set_faults(self, text: str) -> None:
+        """Replace the rule set and reset all counters."""
+        self.specs = parse_faults(text)
+
+    def clear_faults(self) -> None:
+        self.specs = []
+
+    def reset_counters(self) -> None:
+        for s in self.specs:
+            s.count = 0
+
+    def _fault_for(self, op: str, path: str) -> Optional[FaultSpec]:
+        for s in self.specs:
+            if s.fires(op, path):
+                return s
+        return None
+
+    # -- fsspec surface ------------------------------------------------
+    @classmethod
+    def _strip_protocol(cls, path):
+        path = fsspec.utils.stringify_path(path)
+        if path.startswith(cls.protocol + "://"):
+            path = path[len(cls.protocol) + 3:]
+        return path or "/"
+
+    def _open(self, path, mode="rb", **kwargs):
+        if "w" in mode or "a" in mode or "x" in mode:
+            spec = self._fault_for("write", path)
+            return _FaultyWriteFile(
+                self.target, path,
+                spec.mode if spec else None,
+                spec.delay_s if spec else 0.0,
+            )
+        spec = self._fault_for("read", path)
+        if spec is not None:
+            if spec.mode == "missing":
+                raise FileNotFoundError(errno.ENOENT,
+                                        "injected missing object", path)
+            if spec.mode == "error":
+                raise _injected_error("read", path)
+            if spec.mode == "delay":
+                time.sleep(spec.delay_s)
+            if spec.mode == "truncate":
+                with self.target.open(path, "rb") as f:
+                    blob = f.read()
+                return io.BytesIO(blob[: len(blob) // 2])
+        return self.target.open(path, mode, **kwargs)
+
+    # plain delegation — faults apply only to data-plane read/write
+    def info(self, path, **kwargs):
+        return self.target.info(path, **kwargs)
+
+    def ls(self, path, detail=True, **kwargs):
+        return self.target.ls(path, detail=detail, **kwargs)
+
+    def exists(self, path, **kwargs):
+        return self.target.exists(path, **kwargs)
+
+    def rm_file(self, path):
+        return self.target.rm_file(path)
+
+    def rm(self, path, recursive=False, maxdepth=None):
+        return self.target.rm(path, recursive=recursive, maxdepth=maxdepth)
+
+    def makedirs(self, path, exist_ok=False):
+        return self.target.makedirs(path, exist_ok=exist_ok)
+
+    def mkdir(self, path, create_parents=True, **kwargs):
+        return self.target.mkdir(path, create_parents=create_parents, **kwargs)
+
+
+def register() -> None:
+    """Idempotently register ``faulty://`` with fsspec. Imported lazily by
+    train.py/tests; importing this module is enough."""
+    fsspec.register_implementation(
+        "faulty", FaultInjectionFileSystem, clobber=True
+    )
+
+
+register()

@@ -27,6 +27,8 @@ logs (B11).
 from __future__ import annotations
 
 import dataclasses
+import signal
+import threading
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -50,10 +52,17 @@ from mingpt_distributed_tpu.data.char_dataset import (
 from mingpt_distributed_tpu.models import gpt
 from mingpt_distributed_tpu.parallel import mesh as mesh_lib
 from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
+from mingpt_distributed_tpu.training.durability import RetryPolicy
 from mingpt_distributed_tpu.training.metrics import MetricsLogger
 from mingpt_distributed_tpu.training.optimizer import lr_schedule, make_optimizer
 
 TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
+
+# Exit code train.py returns after a preemption-triggered stop+snapshot:
+# EX_TEMPFAIL, the conventional "transient, requeue me" code — cluster
+# schedulers and wrapper scripts can restart the job, which then resumes
+# from the just-committed snapshot.
+REQUEUE_EXIT_CODE = 75
 
 # canonical implementation lives with the other sharding rules
 state_shardings = mesh_lib.state_shardings
@@ -242,6 +251,18 @@ class GPTTrainer:
         self.ckpt_backend = (
             "msgpack" if self.snapshot_path.endswith(".msgpack") else "orbax"
         )
+        # durability: transient-I/O retry policy shared by save and load
+        # (jitter seeded from config.seed for reproducible schedules)
+        self._retry = RetryPolicy(
+            attempts=config.io_retries,
+            base_delay_s=config.io_retry_delay_s,
+            seed=config.seed,
+        )
+        # preemption state: the SIGTERM/SIGINT handler flips
+        # _stop_requested; the step loop honours it at the next boundary
+        self._stop_requested = False
+        self._stop_signal: Optional[int] = None
+        self.preempted = False
         if config.async_save and self.ckpt_backend == "orbax":
             # refuse rather than silently run sync (VERDICT r4 #6): the
             # user asked for overlap they would not be getting
@@ -267,12 +288,14 @@ class GPTTrainer:
                 state_shape["params"],
                 state_shape["opt_state"],
                 shardings=self.shardings,
+                retry=self._retry,
             )
         else:
             restored = ckpt_lib.load_snapshot(
                 self.snapshot_path,
                 state_shape["params"],
                 state_shape["opt_state"],
+                retry=self._retry,
             )
         if restored is None:
             if self.is_writer:
@@ -285,7 +308,7 @@ class GPTTrainer:
                 "opt_state": restored.opt_state,
                 "step": jnp.asarray(restored.step, dtype=jnp.int32),
             }
-            self.state = jax.tree.map(
+            placed = jax.tree.map(
                 lambda x, s: (
                     x  # orbax restores already placed with the right sharding
                     if getattr(x, "sharding", None) == s
@@ -296,6 +319,17 @@ class GPTTrainer:
                 host_state,
                 self.shardings,
             )
+            # Launder the restored buffers through one compiled (undonated)
+            # copy so the donated train step only ever sees executable-owned
+            # buffers: donating externally-created arrays into an executable
+            # deserialised from the persistent compilation cache corrupts
+            # the heap on the CPU backend (resume-then-train segfault; the
+            # fresh-init path was immune because jit(init_fn) outputs are
+            # executable-owned).
+            self.state = jax.jit(
+                lambda s: jax.tree.map(jnp.copy, s),
+                out_shardings=self.shardings,
+            )(placed)
             self.start_epoch = restored.epoch
             self.train_iter.state = IteratorState.from_dict(
                 restored.data_state
@@ -395,10 +429,59 @@ class GPTTrainer:
     def step(self) -> int:
         return int(jax.device_get(self.state["step"]))
 
+    # -- preemption ----------------------------------------------------
+    def request_stop(self, signum: Optional[int] = None) -> None:
+        """Ask the loop to stop at the next step boundary (callable from a
+        signal handler or programmatically). Idempotent."""
+        self._stop_requested = True
+        self._stop_signal = signum
+
+    def _on_signal(self, signum, frame) -> None:
+        if self._stop_requested and signum == signal.SIGINT:
+            # second Ctrl-C: the user really means now
+            raise KeyboardInterrupt
+        name = signal.Signals(signum).name
+        if self.is_writer:
+            print(
+                f"[trainer] {name} received — stopping at the next step "
+                f"boundary, snapshotting, then exiting with code "
+                f"{REQUEUE_EXIT_CODE} (requeue)"
+            )
+        self.request_stop(signum)
+
+    def _install_signal_handlers(self):
+        """SIGTERM (the preemption notice TPU spot VMs deliver) and SIGINT
+        request a graceful stop+snapshot. Returns the handlers to restore,
+        or None when not applicable (off, or not the main thread —
+        python only delivers signals to the main thread)."""
+        if not self.config.handle_signals:
+            return None
+        if threading.current_thread() is not threading.main_thread():
+            return None
+        prev = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            prev[sig] = signal.signal(sig, self._on_signal)
+        return prev
+
     # ------------------------------------------------------------------
     def train(self) -> Dict[str, Any]:
         """Epoch loop (reference train(), trainer.py:169-183): resume at
-        start_epoch, train, periodic eval + snapshot. Returns final metrics."""
+        start_epoch, train, periodic eval + snapshot. Returns final metrics.
+
+        Preemption-safe: a SIGTERM/SIGINT during the loop stops at the
+        next step boundary, snapshots, joins any async save, and sets
+        ``self.preempted`` so the entry point can exit with
+        REQUEUE_EXIT_CODE instead of losing the run.
+        """
+        prev_handlers = self._install_signal_handlers()
+        try:
+            return self._train_loop()
+        finally:
+            if prev_handlers is not None:
+                for sig, h in prev_handlers.items():
+                    signal.signal(sig, h)
+
+    def _train_loop(self) -> Dict[str, Any]:
         cfg = self.config
         last: Dict[str, Any] = {}
         tokens_per_step = cfg.batch_size * self.train_iter.view.block_size
@@ -450,8 +533,14 @@ class GPTTrainer:
                         step, tokens_per_step, self.train_iter.view.block_size,
                         scalars,
                     )
+                if self._stop_requested:
+                    # preemption: get off the chip at this step boundary —
+                    # snapshot below, skip eval, requeue-friendly exit
+                    self.preempted = True
+                    stop = True
                 if cfg.max_steps and step >= cfg.max_steps:
                     stop = True
+                if stop:
                     break
             if stop:
                 # stop the producer thread BEFORE touching iterator state:
@@ -467,7 +556,7 @@ class GPTTrainer:
                     seed=self.train_iter.state.seed,
                 )
             epoch_done = epoch + (0 if stop else 1)
-            if self.test_iter is not None and (
+            if self.test_iter is not None and not self.preempted and (
                 stop or (epoch + 1) % cfg.eval_every == 0
             ):
                 last["eval_loss"] = self.evaluate()
@@ -543,6 +632,7 @@ class GPTTrainer:
                     opt_state=self.state["opt_state"],
                     **common,
                 ),
+                retry=self._retry,
             )
         else:
             if self.process_count > 1:
@@ -594,10 +684,13 @@ class GPTTrainer:
                 import threading
 
                 path, step = self.snapshot_path, self.step
+                keep, retry = self.config.keep_snapshots, self._retry
 
                 def _write():
                     try:
-                        ckpt_lib.save_snapshot(path, host_snap)
+                        ckpt_lib.save_snapshot(
+                            path, host_snap, keep=keep, retry=retry
+                        )
                         print(
                             f"Snapshot saved to {path} "
                             f"(epoch {epoch}, step {step}, msgpack, async)"
@@ -614,6 +707,8 @@ class GPTTrainer:
                     ckpt_lib.Snapshot(
                         params=params, opt_state=opt_state, **common
                     ),
+                    keep=self.config.keep_snapshots,
+                    retry=self._retry,
                 )
         if self.is_writer:
             print(

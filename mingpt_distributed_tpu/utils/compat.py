@@ -1,0 +1,41 @@
+"""Shims for jax API drift between the version this codebase targets and
+the version actually installed in an environment.
+
+The repo tracks current jax surface names (``jax.shard_map``,
+``pallas.tpu.CompilerParams``); older jaxlibs ship the same
+functionality under the pre-promotion names
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``,
+``pallas.tpu.TPUCompilerParams``). Routing every use through this module
+means an environment running either vintage imports and passes tier-1
+instead of dying on AttributeError at import/trace time — dependency
+drift is an availability bug like any other.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as _pltpu
+
+#: pallas TPU compiler-params constructor under either name
+TPUCompilerParams = getattr(
+    _pltpu, "CompilerParams", None
+) or _pltpu.TPUCompilerParams
+
+
+def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` when present, else the experimental spelling.
+
+    ``check_vma`` (the promoted API's name) maps onto the experimental
+    API's ``check_rep``; None lets each implementation use its default.
+    """
+    if hasattr(jax, "shard_map"):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {} if check_vma is None else {"check_rep": check_vma}
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

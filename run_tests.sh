@@ -42,3 +42,10 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest
+
+# Durability gate: fault-injected checkpoint save/restore roundtrip on a
+# tmpdir — every 3rd write fails transiently (retries must absorb it) and
+# the latest blob is truncated (restore must fall back to the previous
+# digest-verified checkpoint, never load the torn one).
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python train.py --selftest-faults

@@ -27,6 +27,13 @@ Common knobs: --slots N, --max-new-tokens, --temperature, --top-k,
 --top-p, --greedy, --eos-text STR (stop when the encoded token appears),
 --metrics-json PATH, --log-every N, plus section.key=value config
 overrides as in train.py/sample.py.
+
+Robustness knobs (ISSUE 2): --queue-limit N bounds the request queue
+(over-limit submissions are rejected with a clean error instead of
+growing without bound); --deadline-s S expires requests that exceed
+their deadline, queued or mid-decode, so an abandoned request can't pin
+a KV slot. One failing prompt (encode error, validation error, queue
+rejection) is reported and skipped — the engine keeps serving.
 """
 
 from __future__ import annotations
@@ -60,6 +67,13 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="write the serving metrics summary JSON here")
     p.add_argument("--log-every", type=int, default=20,
                    help="scheduler steps between metric log lines (0 = off)")
+    p.add_argument("--queue-limit", type=int, default=None,
+                   help="bound the request queue; over-limit submissions "
+                        "are rejected (backpressure) instead of queueing "
+                        "without bound")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="per-request deadline in seconds; expired requests "
+                        "free their KV slot (finish_reason=deadline)")
     p.add_argument("overrides", nargs="*")
     return p
 
@@ -76,6 +90,7 @@ def _request_for(args, tokens, eos_id=None):
         do_sample=not args.greedy,
         eos_id=eos_id,
         seed=args.seed,
+        deadline_s=args.deadline_s,
     )
 
 
@@ -185,10 +200,24 @@ def main(argv=None) -> int:
         with open(args.prompts_file) as f:
             lines = [ln.rstrip("\n") for ln in f if ln.strip()]
         server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
-                                 log_every=args.log_every)
-        handles = server.generate_batch(
-            [_request_for(args, dataset.encode(ln), eos_id) for ln in lines])
-        for ln, h in zip(lines, handles):
+                                 log_every=args.log_every,
+                                 max_queue=args.queue_limit,
+                                 default_deadline_s=args.deadline_s)
+        # per-request isolation: one bad prompt (encode failure, validation
+        # error, queue rejection) is reported and skipped — the batch keeps
+        # draining instead of the whole engine tearing down
+        handles = []
+        for ln in lines:
+            try:
+                handles.append(
+                    (ln, server.submit(_request_for(
+                        args, dataset.encode(ln), eos_id))))
+            except Exception as e:
+                print(f"=== skipped ({type(e).__name__}: {e}) ===\n{ln}",
+                      file=sys.stderr)
+            server.step()  # drain as we go so a bounded queue makes progress
+        server.run_until_drained()
+        for ln, h in handles:
             print(f"=== {h.request_id} ({h.finish_reason}) ===")
             print(ln + dataset.decode(h.tokens))
         print(json.dumps(server.summary()))
@@ -198,7 +227,9 @@ def main(argv=None) -> int:
 
     # REPL: one prompt per stdin line, streamed as it decodes
     server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
-                             on_token=on_token, log_every=0)
+                             on_token=on_token, log_every=0,
+                             max_queue=args.queue_limit,
+                             default_deadline_s=args.deadline_s)
     interactive = sys.stdin.isatty()
     if interactive:
         print("prompt> ", end="", flush=True)
@@ -208,10 +239,17 @@ def main(argv=None) -> int:
             if interactive:
                 print("prompt> ", end="", flush=True)
             continue
-        sys.stdout.write(prompt)
-        server.submit(_request_for(args, dataset.encode(prompt), eos_id))
-        server.run_until_drained()
-        print()
+        # one failing request must not tear down the REPL: report, reprompt
+        try:
+            sys.stdout.write(prompt)
+            server.submit(_request_for(args, dataset.encode(prompt), eos_id))
+            server.run_until_drained()
+            print()
+        except KeyboardInterrupt:
+            raise
+        except Exception as e:
+            print(f"\n[serve] request failed ({type(e).__name__}: {e}); "
+                  "still serving", file=sys.stderr)
         if interactive:
             print("prompt> ", end="", flush=True)
     if args.metrics_json:

@@ -21,6 +21,7 @@ from mingpt_distributed_tpu.models import generate as gen
 from mingpt_distributed_tpu.models import gpt
 from mingpt_distributed_tpu.serving import (
     InferenceServer,
+    QueueFullError,
     Request,
     SlotKVPool,
 )
@@ -216,6 +217,94 @@ def test_request_validation(cfg_params):
         server.submit(Request(prompt=[], max_new_tokens=3))
     with pytest.raises(ValueError):
         server.submit(Request(prompt=[1], max_new_tokens=0))
+
+
+# ---------------------------------------------------------------------------
+# robustness: bounded queue, deadlines, callback isolation (ISSUE 2)
+# ---------------------------------------------------------------------------
+
+
+def test_bounded_queue_rejects_beyond_limit(cfg_params):
+    """max_queue bounds WAITING requests; over-limit submissions raise
+    QueueFullError cleanly and are counted, already-queued work drains."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=1, max_queue=2)
+    h_ok = [server.submit(Request(prompt=p, max_new_tokens=3))
+            for p in PROMPTS[:2]]
+    with pytest.raises(QueueFullError):
+        server.submit(Request(prompt=PROMPTS[2], max_new_tokens=3))
+    assert server.metrics.requests_rejected == 1
+    assert server.metrics.requests_submitted == 2
+    server.run_until_drained(max_steps=100)
+    for h in h_ok:
+        assert h.finished and h.finish_reason == "length"
+    # capacity freed: submissions are accepted again
+    h3 = server.submit(Request(prompt=PROMPTS[2], max_new_tokens=3))
+    server.run_until_drained(max_steps=100)
+    assert h3.finished
+
+
+def test_deadline_expires_queued_request_without_taking_a_slot(cfg_params):
+    cfg, params = cfg_params
+    t = {"now": 0.0}
+    server = InferenceServer(params, cfg, n_slots=1, clock=lambda: t["now"])
+    h_busy = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=8))
+    h_doomed = server.submit(
+        Request(prompt=PROMPTS[1], max_new_tokens=8, deadline_s=5.0))
+    server.step()  # h_busy admitted, h_doomed queued
+    assert h_busy.slot is not None and not h_doomed.finished
+    t["now"] = 6.0  # past h_doomed's deadline while it still waits
+    server.step()
+    assert h_doomed.finished and h_doomed.finish_reason == "deadline"
+    assert h_doomed.tokens == []  # expired before ever taking a slot
+    server.run_until_drained(max_steps=100)
+    assert h_busy.finish_reason == "length"
+    assert server.metrics.requests_expired == 1
+
+
+def test_deadline_frees_slot_of_abandoned_mid_decode_request(cfg_params):
+    """An in-flight request past its deadline must release its KV slot at
+    the next step boundary — an abandoned caller can't pin a slot."""
+    cfg, params = cfg_params
+    t = {"now": 0.0}
+    server = InferenceServer(params, cfg, n_slots=1, clock=lambda: t["now"],
+                             default_deadline_s=10.0)
+    h = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=1000))
+    server.step()
+    server.step()
+    assert not h.finished and h.slot is not None
+    t["now"] = 11.0
+    server.step()
+    assert h.finished and h.finish_reason == "deadline"
+    assert h.slot is None and server.engine.pool.free_count == 1
+    # the freed slot is immediately reusable, decode state intact
+    h2 = server.submit(Request(prompt=PROMPTS[1], max_new_tokens=4,
+                               deadline_s=100.0))
+    server.run_until_drained(max_steps=100)
+    assert h2.finish_reason == "length"
+    assert h2.tokens == solo_greedy(params, cfg, PROMPTS[1], 4)
+
+
+def test_raising_callback_frees_slot_and_server_keeps_serving(cfg_params):
+    cfg, params = cfg_params
+    calls = {"n": 0}
+
+    def bad_cb(handle, tok):
+        calls["n"] += 1
+        raise RuntimeError("consumer went away")
+
+    server = InferenceServer(params, cfg, n_slots=2, on_token=bad_cb)
+    h_bad = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=8))
+    server.step()  # prefill emits the first token -> callback raises
+    assert h_bad.finished and h_bad.finish_reason == "error"
+    assert isinstance(h_bad.error, RuntimeError)
+    assert server.engine.pool.free_count == 2  # slot released, not leaked
+    assert server.metrics.requests_failed == 1
+    # server survives: a well-behaved request still decodes to parity
+    server.on_token = None
+    h_ok = server.submit(Request(prompt=PROMPTS[1], max_new_tokens=6))
+    server.run_until_drained(max_steps=100)
+    assert h_ok.tokens == solo_greedy(params, cfg, PROMPTS[1], 6)
 
 
 def test_llama_mode_serving_parity(cfg_params):

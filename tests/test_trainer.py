@@ -249,7 +249,9 @@ def test_snapshot_object_store_roundtrip():
     """fsspec memory:// exercises the "://" (object-store) transport branch in
     save_snapshot/load_snapshot — the path that represents the reference's S3
     upload (/root/reference/mingpt/trainer.py:83-95) — without needing real
-    S3/GCS credentials."""
+    S3/GCS credentials. Since ISSUE 2 remote saves are manifest-committed:
+    a step-suffixed data object plus ``<path>.manifest.json`` (latest
+    pointer + SHA-256 digest), not a single in-place key."""
     import fsspec
 
     from mingpt_distributed_tpu.training import checkpoint as ckpt
@@ -262,7 +264,9 @@ def test_snapshot_object_store_roundtrip():
         prng=np.array([1, 2], np.uint32), data_state={"pos": 3},
         config={"n_layer": 2},
     ))
-    assert fsspec.filesystem("memory").exists("/bucket/key/snap.msgpack")
+    mem = fsspec.filesystem("memory")
+    assert mem.exists("/bucket/key/snap.msgpack.manifest.json")
+    assert mem.exists("/bucket/key/snap.msgpack.step-00000007")
     snap = ckpt.load_snapshot(path, params, opt)
     assert snap is not None and snap.step == 7 and snap.epoch == 1
     np.testing.assert_array_equal(snap.params["w"], params["w"])

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from mingpt_distributed_tpu.utils import compat
+
 from mingpt_distributed_tpu.ops import attention as attn_ops
 from mingpt_distributed_tpu.ops import flash_attention as fa
 
@@ -132,7 +134,7 @@ def flash_fwd_btd(q, k, v, h, scale, block, window=None, softcap=None):
             pltpu.VMEM((2, block, 1), jnp.float32),
             pltpu.VMEM((2, block, hd), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")
         ),
@@ -329,7 +331,7 @@ def flash_bwd_btd(q, k, v, do, lse, delta, h, scale, block):
         out_specs=[io_q],
         out_shape=[jax.ShapeDtypeStruct((b, t, d), q.dtype)],
         scratch_shapes=[pltpu.VMEM((2, block, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=fa._interpret(),
@@ -355,7 +357,7 @@ def flash_bwd_btd(q, k, v, do, lse, delta, h, scale, block):
                    jax.ShapeDtypeStruct((b, t, d), v.dtype)],
         scratch_shapes=[pltpu.VMEM((2, block, hd), jnp.float32),
                         pltpu.VMEM((2, block, hd), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.TPUCompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=fa._interpret(),
