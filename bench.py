@@ -30,7 +30,6 @@ import sys
 import time
 
 METRIC = "mfu_gpt2_124m_seq1024"
-PROBE_TIMEOUT_S = 240
 
 
 def _env_num(name: str, default, cast):
@@ -41,6 +40,9 @@ def _env_num(name: str, default, cast):
     except (KeyError, ValueError):
         return default
     return val if val >= 0 else default
+
+
+PROBE_TIMEOUT_S = _env_num("BENCH_PROBE_TIMEOUT_S", 240, int)
 
 
 # VERDICT r2: a single 240 s probe converted a flaky-but-recoverable tunnel
@@ -125,6 +127,44 @@ def _probe_backend() -> dict:
         return json.loads(proc.stdout.strip().splitlines()[-1])
     except (ValueError, IndexError):
         return {"error": "backend probe produced no JSON"}
+
+
+def _cpu_fallback_record(probe_error: str) -> dict | None:
+    """Smaller-geometry CPU measurement for when the accelerator probe is
+    dead (the mfu trajectory was null for five straight rounds because a
+    240 s probe timeout produced an error record and nothing else). Runs
+    the same inner sweep on the CPU backend with a small model/short
+    sequence so the metric records a *real, clearly-labelled* number —
+    MFU against a measured CPU matmul peak — instead of null. Returns the
+    parsed record (tagged backend=cpu_fallback) or None if even the CPU
+    run failed."""
+    env = dict(
+        os.environ,
+        # force the hermetic CPU backend the test wrapper uses: the
+        # ambient TPU-plugin sitecustomize must not re-dial the dead
+        # tunnel from inside the fallback
+        PYTHONPATH="", PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu",
+        BENCH_MODEL=os.environ.get("BENCH_CPU_MODEL", "gpt-mini"),
+        BENCH_SEQ=os.environ.get("BENCH_CPU_SEQ", "256"),
+        BENCH_BATCHES=os.environ.get("BENCH_CPU_BATCHES", "8,4"),
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--inner"],
+            capture_output=True, text=True, timeout=BENCH_TIMEOUT_S, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    sys.stderr.write(proc.stderr)
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            record = json.loads(line)
+        except ValueError:
+            continue
+        record["backend"] = "cpu_fallback"
+        record["probe_error"] = probe_error
+        return record
+    return None
 
 
 def _probe_backend_with_retry() -> dict:
@@ -265,7 +305,14 @@ def profile_inner(outdir: str) -> int:
 def main() -> int:
     probe = _probe_backend_with_retry()
     if "error" in probe:
-        print(json.dumps(_error_record(probe["error"])))
+        # dead accelerator: record a real (labelled) CPU number rather
+        # than yet another null round artifact
+        print(f"probe failed ({probe['error']}); falling back to a "
+              "smaller-geometry CPU measurement", file=sys.stderr)
+        record = _cpu_fallback_record(probe["error"])
+        if record is None:
+            record = _error_record(probe["error"])
+        print(json.dumps(record))
         return 0
     if "--profile" in sys.argv:
         i = sys.argv.index("--profile")
@@ -667,6 +714,23 @@ def inner() -> int:
     cfg = GPTConfig.make(model_type=model)
     fpt = flops_per_token(cfg, seq)
     peak = peak_flops_per_chip()
+    peak_source = "chip_table" if peak else None
+    if peak is None and jax.default_backend() == "cpu":
+        # no table entry for CPUs: measure an achievable matmul FLOP rate
+        # so the cpu-fallback path can still report a real MFU-style
+        # fraction (clearly labelled — it is a proxy denominator, not a
+        # chip spec)
+        n = 1024
+        a = jax.random.normal(jax.random.key(0), (n, n), jnp.float32)
+        mm = jax.jit(lambda a: a @ a)
+        mm(a).block_until_ready()
+        best = 0.0
+        for _ in range(3):
+            t0 = time.perf_counter()
+            mm(a).block_until_ready()
+            best = max(best, 2.0 * n ** 3 / (time.perf_counter() - t0))
+        peak = best
+        peak_source = "measured_cpu_matmul"
 
     def mfu_of(batch: int, sps: float) -> tuple[float, float | None]:
         tps = sps * batch * seq
@@ -736,6 +800,7 @@ def inner() -> int:
             "flops_per_token": fpt,
             "achieved_tflops": round(tokens_per_sec * fpt / 1e12, 2),
             "peak_tflops": round(peak / 1e12, 1) if peak else None,
+            "peak_source": peak_source,
             "batch": batch,
             "seq": seq,
             "device": dev.device_kind,
@@ -743,6 +808,7 @@ def inner() -> int:
             "paths": per_path,
             "long_context": long_ctx,
             "decode": decode,  # KV-cached greedy decode extra (TPU only)
+            "serving": serving,  # continuous-batching admission probe
         }
         print(json.dumps(record), flush=True)
 
@@ -750,6 +816,7 @@ def inner() -> int:
     # outer process parses the last complete JSON line and the
     # already-measured MFU is never lost
     decode = None
+    serving = None
     emit(None)
 
     # long-context line (SURVEY §5.7): one bounded flash fwd+bwd at T=8192 —
@@ -895,9 +962,99 @@ def inner() -> int:
     except Exception as e:  # noqa: BLE001 — optional extra, never fatal
         print(f"decode extra skipped: {e}", file=sys.stderr)
 
-    if long_ctx is not None or decode is not None:
+    # serving-throughput extra (ISSUE 3): the continuous-batching server
+    # under a mixed short/long prompt trace with bucketed + chunked prefill
+    # and the shared-prefix store on. Records tokens/sec and — the
+    # acceptance evidence — per-admission cost scaling: a short prompt's
+    # compiled prefill is measurably cheaper than a full-window one, and a
+    # prefix-cache hit pays only its tail. A tiny model keeps the extra
+    # bounded on every backend (the numbers compare prefill geometries to
+    # EACH OTHER, which a tiny model preserves).
+    try:
+        if os.environ.get("BENCH_SERVING", "1") == "0":
+            raise RuntimeError("disabled via BENCH_SERVING=0")
+        serving = serving_probe()
+        print(f"serving extra: {json.dumps(serving)}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — optional extra, never fatal
+        print(f"serving extra skipped: {e}", file=sys.stderr)
+
+    if long_ctx is not None or decode is not None or serving is not None:
         emit(long_ctx)  # augmented record supersedes the headline-only one
     return 0
+
+
+def serving_probe() -> dict:
+    """Continuous-batching admission/throughput probe on a tiny model.
+
+    Trace: 24 requests, cycling long (100-token) / shared-prefix (48-token
+    system prompt + 8) / short (12-token) prompts through 4 slots with a
+    (16, 32, 64, 128) bucket ladder, 32-token chunks and the prefix store
+    enabled. Also times the compiled prefill at three admission
+    geometries after warmup — short bucket, full window, prefix-hit tail
+    — which is the prompt-length-proportional-cost claim in one place.
+    """
+    import jax
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import InferenceServer, Request
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=64, vocab_size=256, block_size=128,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    server = InferenceServer(
+        params, cfg, n_slots=4, prefill_buckets=(16, 32, 64, 128),
+        prefill_chunk=32, prefix_cache_mb=16.0, warmup=True,
+    )
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, 48).tolist()
+    reqs = []
+    for i in range(24):
+        if i % 3 == 0:
+            prompt = rng.randint(0, cfg.vocab_size, 100).tolist()
+        elif i % 3 == 1:
+            prompt = shared + rng.randint(0, cfg.vocab_size, 8).tolist()
+        else:
+            prompt = rng.randint(0, cfg.vocab_size, 12).tolist()
+        reqs.append(Request(prompt=prompt, max_new_tokens=16))
+    t0 = time.perf_counter()
+    handles = server.generate_batch(reqs)
+    wall = time.perf_counter() - t0
+    m = server.summary()
+    assert all(h.finished for h in handles)
+
+    eng = server.engine
+    key = jax.random.key(1)
+
+    def prefill_ms(n_tokens: int, offset: int = 0) -> float:
+        ids = list(range(1, n_tokens + 1))
+        t0 = time.perf_counter()
+        for _ in range(5):
+            eng.prefill_chunk_call(0, ids, offset, 1.0, None, None, False, key)
+        return (time.perf_counter() - t0) / 5 * 1e3
+
+    short_ms = prefill_ms(16)            # 16-token prompt, bucket 16
+    full_ms = prefill_ms(cfg.block_size)  # full-window prompt
+    tail_ms = prefill_ms(16, offset=48)  # what a 48-row prefix hit leaves
+    return {
+        "tokens_per_sec": round(m["tokens_generated"] / wall, 1),
+        "requests": len(reqs),
+        "slots": 4,
+        "buckets": list(eng.buckets),
+        "prefill_chunk": eng.prefill_chunk,
+        "prefill_pad_overhead": round(m["prefill_pad_overhead"], 3),
+        "prefix_hit_rate": round(m["prefix_hit_rate"], 3),
+        "prefix_rows_reused": m["prefix_rows_reused"],
+        "admission_stall_mean_ms": round(
+            m["admission_stall_mean_s"] * 1e3, 2),
+        "prefill_short16_ms": round(short_ms, 2),
+        "prefill_full_window_ms": round(full_ms, 2),
+        "prefill_prefix_tail_ms": round(tail_ms, 2),
+        "short_vs_full_speedup": round(full_ms / short_ms, 2),
+    }
 
 
 if __name__ == "__main__":

@@ -140,7 +140,36 @@ def _attention_dispatch(cfg: GPTConfig, mesh=None):
     if cfg.attention == "flash":
         from mingpt_distributed_tpu.ops import flash_attention
 
-        return flash_attention.causal_attention
+        if mesh is None:
+            return flash_attention.causal_attention
+
+        # The Pallas kernel is a single program whose packed-lane cells
+        # (128 lanes = up to 128/hd sub-heads, ops/flash_attention._btd_pack)
+        # must never be SPLIT by the partitioner: GSPMD sharding q's head
+        # axis over tp can land a shard boundary inside one cell, and the
+        # interpret-mode lowering of the kernel then computes garbage
+        # (observed: head_dim=16 → pack=8 one-cell geometry, fwd AND grads
+        # wrong under tp=2 — the llama hd16/GQA divergence; head_dim=64 →
+        # pack=2 only survived because tp=2 happened to split on a cell
+        # boundary). Batch-dim sharding is the one partitioning the kernel
+        # is safe under, so pin q/k/v/out to batch-only: a no-op for the
+        # dp/fsdp training path, an explicit head all-gather for the
+        # non-tp-manual tp>1 corner (correct first; the aligned-head tp
+        # cases run the manual-tp path and never see this wrapper).
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as PSpec
+
+        from mingpt_distributed_tpu.parallel.mesh import BATCH_AXES
+
+        batch_only = NamedSharding(mesh, PSpec(BATCH_AXES))
+
+        def flash_batch_partitioned(q, k, v, **kw):
+            cst = lambda a: jax.lax.with_sharding_constraint(a, batch_only)
+            out = flash_attention.causal_attention(
+                cst(q), cst(k), cst(v), **kw)
+            return jax.lax.with_sharding_constraint(out, batch_only)
+
+        return flash_batch_partitioned
     if cfg.attention == "ring":
         from mingpt_distributed_tpu.parallel import ring_attention
 

@@ -166,7 +166,9 @@ def _make_1f1b(tick_scan, apply_stack, pp: int, m: int):
                 bstate,
             )
             g_y = jnp.where(bvalid, g_in, jnp.zeros_like(g_in))
-            g_a = jnp.where(bvalid, g_aux, 0.0).astype(jnp.float32)
+            # g_aux arrives shaped (1,) (the region-internal aux shape);
+            # apply_stack's own aux output is scalar, so its ct must be too
+            g_a = jnp.where(bvalid, g_aux.reshape(()), 0.0).astype(jnp.float32)
 
             def apply_d(x, dxs, dc):
                 return apply_stack(
@@ -281,10 +283,16 @@ def pipeline_blocks(
                 state = jax.lax.ppermute(state, "pp", shift)
                 return (state, outs, aux_tot), None
 
+            # the aux accumulator rides as shape (1,), NOT a scalar: jaxlib
+            # 0.4.x's shard_map partial-eval names every linearization
+            # residual {0: all_axes}, which is rank-invalid for scalars and
+            # makes jit(grad(...)) of the region raise _SpecError — keeping
+            # every differentiable intermediate rank >= 1 sidesteps it
+            # (scalarised again at the region boundary below).
             (_, outs, aux_tot), _ = jax.lax.scan(
                 tick,
                 (jnp.zeros_like(mbs_[0]), jnp.zeros_like(mbs_),
-                 jnp.zeros((), jnp.float32)),
+                 jnp.zeros((1,), jnp.float32)),
                 jnp.arange(m + pp - 1),
             )
             return outs, aux_tot
@@ -297,16 +305,20 @@ def pipeline_blocks(
             outs, aux_tot = tick_scan(mbs, xs_local, consts_)
         stage = jax.lax.axis_index("pp")
         # results live on the last stage; broadcast so every stage returns
-        # the full activations (head/loss then run replicated over pp)
-        outs = jax.lax.psum(
-            jnp.where(stage == pp - 1, outs, jnp.zeros_like(outs)), "pp"
-        )
+        # the full activations (head/loss then run replicated over pp).
+        # The mask is materialised at rank outs.ndim rather than passed as
+        # a scalar `where` condition: jaxlib 0.4.x's shard_map partial
+        # eval names every residual {0: all_axes}, which is rank-invalid
+        # for a scalar residual and makes jit(grad(...)) of this region
+        # die with _SpecError — a rank-1+ residual sidesteps the bug.
+        mask = (stage == pp - 1).astype(outs.dtype).reshape((1,) * outs.ndim)
+        outs = jax.lax.psum(outs * mask, "pp")
         # aux: sum over stages (each holds different layers), mean over
         # microbatches and over the batch-ish/sequence shards — the same
         # estimator as the single-device full-batch mean
         aux = jax.lax.psum(aux_tot, "pp") / m
         aux = jax.lax.pmean(aux, BATCH_AXES + (("sp",) if seq_sharded else ()))
-        return outs.reshape(x_local.shape), aux
+        return outs.reshape(x_local.shape), aux.reshape(())
 
     seq_ax = "sp" if seq_sharded else None
     x_spec = P(BATCH_AXES, seq_ax, *([None] * (x.ndim - 2)))

@@ -7,15 +7,18 @@ what a server needs on top of it:
 
 * ``SlotKVPool`` (kv_pool.py) — a fixed (L, S_slots, block_size, KV, hd)
   cache where each slot holds one in-flight request, with a deterministic
-  host-side allocate/free free-list.
-* ``DecodeEngine`` (engine.py) — exactly two compiled programs, shared by
-  every request for the server's lifetime: prefill-into-slot and a
-  one-token-per-step decode over all slots (per-slot positions, masked
-  inactive slots, per-slot sampling params as traced arrays — admission
-  never recompiles).
+  host-side allocate/free free-list; ``PrefixKVStore`` is the byte-bounded
+  LRU of shared-prefix KV entries behind prefix reuse.
+* ``DecodeEngine`` (engine.py) — a bounded compiled-program family shared
+  by every request for the server's lifetime: bucket-laddered
+  prefill-at-offset (O(log block_size) executables; prefill FLOPs track
+  prompt length), a one-token-per-step decode over all slots (per-slot
+  positions, masked inactive slots, per-slot sampling params as traced
+  arrays — admission never recompiles), and device-side prefix row copies.
 * ``InferenceServer`` (scheduler.py) — the continuous-batching scheduler:
   a FIFO request queue with per-request sampling params, admission into
-  free slots at decode-step boundaries, retirement on per-request stop
+  free slots at decode-step boundaries (prefix hit → chunked prefill
+  interleaved with decode → first token), retirement on per-request stop
   conditions, token streaming via callbacks / request handles.
 * ``ServingMetrics`` (metrics.py) — tokens/sec, queue depth, slot
   utilization, per-request TTFT and inter-token latency; periodic log line
@@ -27,7 +30,7 @@ driven end-to-end by ``serve.py`` at the repo root.
 """
 
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
-from mingpt_distributed_tpu.serving.kv_pool import SlotKVPool
+from mingpt_distributed_tpu.serving.kv_pool import PrefixKVStore, SlotKVPool
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
 from mingpt_distributed_tpu.serving.scheduler import (
     InferenceServer,
@@ -39,6 +42,7 @@ from mingpt_distributed_tpu.serving.scheduler import (
 __all__ = [
     "DecodeEngine",
     "InferenceServer",
+    "PrefixKVStore",
     "QueueFullError",
     "Request",
     "RequestHandle",

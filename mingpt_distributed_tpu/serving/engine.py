@@ -1,15 +1,21 @@
 """Compiled prefill / multi-slot decode for the continuous-batching server.
 
-Exactly TWO programs are compiled, once each, for the server's lifetime:
+A bounded family of programs is compiled, once each, for the server's
+lifetime:
 
-1. **prefill-into-slot** — one forward over a right-padded ``(1,
-   prefill_len)`` prompt through ``generate._forward_cached_hidden`` (the
-   same unrolled cached-block chain solo ``generate()`` uses), whose
-   batch-1 cache is then written whole into the pool at a *traced* slot
-   index. Logits are read at the *traced* position ``length - 1`` before
-   the LM head, and the first token is sampled on device. Every dynamic
-   quantity (slot, prompt length, sampling params, PRNG key) is a traced
-   argument, so admitting request #100 reuses request #1's executable.
+1. **prefill-at-offset** — one forward over a right-padded token chunk
+   through ``generate._forward_cached_hidden`` (the same unrolled
+   cached-block chain solo ``generate()`` uses) against the slot's cache
+   lane at a *traced* absolute offset, whose updated lane is written back
+   into the pool at a *traced* slot index. Logits are read at the *traced*
+   position ``length - 1`` and the next token is sampled on device. The
+   chunk is padded to the smallest covering **bucket** from a power-of-two
+   ladder (``prefill_buckets``), so the executable count is O(log
+   block_size) while prefill FLOPs track the chunk length — a 10-token
+   prompt no longer pays a block_size² attention forward. The same
+   program serves whole short prompts (offset 0), the per-step chunks of
+   a long prompt (``prefill_chunk``-token pieces between decode steps),
+   and the tail after a prefix-cache hit.
 
 2. **decode-step** — one token for every slot at once: ``vmap`` over the
    slot axis of the same ``_forward_cached`` the solo scan uses, each lane
@@ -18,13 +24,18 @@ Exactly TWO programs are compiled, once each, for the server's lifetime:
    dynamic_update_slice lowers to a one-row-per-slot scatter, NOT a
    whole-cache rewrite). Per-slot sampling params ride as traced arrays.
 
-Padding correctness: the prompt is right-padded to ``prefill_len``. Causal
-masking means real positions never attend a pad position ahead of them,
-and a pad position's stale K/V only becomes visible at the decode step
-that first *writes* that position with a real token — so garbage is
-overwritten before it can ever be attended. Inactive slots keep decoding
-masked-out lanes into their own (dead) cache rows; admission prefill
-overwrites the slot before reuse.
+3. **prefix extract / install** (only when the prefix store is enabled) —
+   device-side row copies between a slot lane and a shared-prefix cache
+   entry, one trace per bucket-quantized prefix length.
+
+Padding correctness: the *stale-row invariant*. A cache row only becomes
+visible to attention once a query position reaches it, and every writer
+(prefill chunk or decode step) writes real K/V to a row *before* the
+first query that could attend it — causal masking is positional, not
+value-based, so rows past the real-token frontier may hold anything:
+pad garbage from a bucket, a previous tenant's K/V, or a parked decode
+lane's scribbles at ``block_size - 1``. This is why admission no longer
+needs to zero a slot and why chunked prefill can interleave with decode.
 
 Sampling parity: the per-slot sampler mirrors ``generate._select_next``
 (temperature → top-k → top-p → sample/argmax) with the params as traced
@@ -32,12 +43,15 @@ per-slot arrays instead of static python scalars — which is what keeps one
 compiled program serving mixed greedy/sampled tenants. For greedy lanes
 the filters cannot move the argmax, so a greedy request's tokens match
 solo ``generate()`` exactly (tests/test_serving.py asserts token identity).
+Chunked prefill is exactly row-equivalent to one whole-prompt forward:
+attention, MLP and norms are row-wise, and a chunk's queries see the same
+keys at the same absolute positions the one-shot forward would.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Dict, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -45,7 +59,41 @@ import numpy as np
 
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.models import generate as gen
-from mingpt_distributed_tpu.serving.kv_pool import SlotKVPool
+from mingpt_distributed_tpu.serving.kv_pool import PrefixKVStore, SlotKVPool
+
+#: smallest default bucket — prompts below this pay one 64-token forward,
+#: which already beats a block_size² prefill by >100x at block_size 1024
+DEFAULT_MIN_BUCKET = 64
+
+
+def bucket_ladder(
+    prefill_len: int,
+    buckets: Optional[Sequence[int]] = None,
+    chunk: Optional[int] = None,
+) -> Tuple[int, ...]:
+    """The sorted ladder of compiled prefill lengths.
+
+    Default: powers of two from ``min(DEFAULT_MIN_BUCKET, prefill_len)``
+    up to ``prefill_len``, always including ``prefill_len`` itself (and
+    ``chunk`` when chunked prefill is on, so full chunks never pad) —
+    O(log prefill_len) entries.
+    """
+    if buckets is not None:
+        vals = {int(b) for b in buckets}
+        for b in vals:
+            if not (1 <= b <= prefill_len):
+                raise ValueError(
+                    f"prefill bucket {b} outside [1, {prefill_len}]")
+    else:
+        vals = set()
+        b = min(DEFAULT_MIN_BUCKET, prefill_len)
+        while b < prefill_len:
+            vals.add(b)
+            b *= 2
+    vals.add(prefill_len)
+    if chunk is not None:
+        vals.add(int(chunk))
+    return tuple(sorted(vals))
 
 
 def _select_next_slots(
@@ -82,29 +130,44 @@ def _select_next_slots(
     return jnp.where(do_sample, sampled, greedy).astype(jnp.int32)
 
 
+def _slot_lane(cache, slot):
+    """The (L, 1, S, KV, hd) cache lane of one slot."""
+    l, _, s, kv, hd = cache["k"].shape
+    return {
+        name: jax.lax.dynamic_slice(
+            cache[name], (0, slot, 0, 0, 0), (l, 1, s, kv, hd))
+        for name in ("k", "v")
+    }
+
+
+def _install_lane(cache, lane, slot):
+    return {
+        name: jax.lax.dynamic_update_slice(
+            cache[name], lane[name], (0, slot, 0, 0, 0))
+        for name in ("k", "v")
+    }
+
+
 def _prefill_impl(
-    params, cache, prompt, length, slot, temp, top_k, top_p, do_sample, key,
+    params, cache, chunk, length, offset, slot,
+    temp, top_k, top_p, do_sample, key,
     *, cfg: GPTConfig,
 ):
-    """prompt: (prefill_len,) right-padded; length/slot traced scalars.
-    Returns (first sampled token (scalar int32), updated pool cache)."""
-    scratch = gen.init_cache(cfg, 1, dtype=cache["k"].dtype)
-    x, scratch = gen._forward_cached_hidden(params, prompt[None], scratch, 0, cfg)
+    """chunk: (bucket,) right-padded tokens; length/offset/slot traced
+    scalars. Forwards the chunk at absolute position ``offset`` against
+    the slot's cache lane (attending everything written before it) and
+    writes the lane back. Returns (token sampled at within-chunk position
+    ``length - 1`` (scalar int32), updated pool cache) — the caller only
+    uses the token on the final chunk of a prompt."""
+    lane = _slot_lane(cache, slot)
+    x, lane = gen._forward_cached_hidden(params, chunk[None], lane, offset, cfg)
     h_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
     logits = gen._head_logits(params, h_last, cfg)[:, 0]  # (1, V)
-    first = _select_next_slots(
+    tok = _select_next_slots(
         logits, key[None], temp[None], top_k[None], top_p[None],
         do_sample[None],
     )[0]
-    # the scratch cache covers the slot's FULL length (zeros past the
-    # prompt), so installing it evicts every byte of the previous tenant
-    cache = {
-        "k": jax.lax.dynamic_update_slice(
-            cache["k"], scratch["k"], (0, slot, 0, 0, 0)),
-        "v": jax.lax.dynamic_update_slice(
-            cache["v"], scratch["v"], (0, slot, 0, 0, 0)),
-    }
-    return first, cache
+    return tok, _install_lane(cache, lane, slot)
 
 
 def _decode_impl(
@@ -129,12 +192,37 @@ def _decode_impl(
     return nxt, cache
 
 
+def _extract_prefix_impl(cache, slot, *, rows: int):
+    """Copy the first ``rows`` K/V rows of a slot lane out of the pool —
+    the device-side read half of a prefix-store insert. ``rows`` is static
+    (one trace per bucket-quantized prefix length)."""
+    l, _, _, kv, hd = cache["k"].shape
+    return {
+        name: jax.lax.dynamic_slice(
+            cache[name], (0, slot, 0, 0, 0), (l, 1, rows, kv, hd))
+        for name in ("k", "v")
+    }
+
+
+def _install_prefix_impl(cache, entry_k, entry_v, slot):
+    """Write a stored (L, 1, P, KV, hd) prefix entry into rows [0, P) of a
+    slot lane — a device-side dynamic_update_slice, no recompute."""
+    return {
+        "k": jax.lax.dynamic_update_slice(
+            cache["k"], entry_k.astype(cache["k"].dtype), (0, slot, 0, 0, 0)),
+        "v": jax.lax.dynamic_update_slice(
+            cache["v"], entry_v.astype(cache["v"].dtype), (0, slot, 0, 0, 0)),
+    }
+
+
 class DecodeEngine:
-    """Owns the slot pool and the two jitted programs.
+    """Owns the slot pool, the bucket ladder, the optional prefix store,
+    and the jitted programs.
 
     The jit wrappers are per-engine objects so their compile caches count
     only this engine's traces — ``compile_counts()`` is how the tests
-    assert the no-recompile-after-warmup guarantee.
+    assert the bounded-program guarantee: decode stays at 1 trace and
+    prefill at <= len(ladder) traces for the engine's lifetime.
     """
 
     def __init__(
@@ -144,6 +232,9 @@ class DecodeEngine:
         n_slots: int,
         prefill_len: Optional[int] = None,
         cache_dtype=None,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache_mb: float = 0.0,
     ):
         self.cfg = cfg
         self.params = params
@@ -153,46 +244,156 @@ class DecodeEngine:
                 f"prefill_len {self.prefill_len} outside [1, "
                 f"{cfg.block_size}]"
             )
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if not (1 <= prefill_chunk <= self.prefill_len):
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} outside [1, "
+                    f"{self.prefill_len}]"
+                )
+        self.prefill_chunk = prefill_chunk
+        self.buckets = bucket_ladder(
+            self.prefill_len, prefill_buckets, prefill_chunk)
         self.pool = SlotKVPool(cfg, n_slots, cache_dtype)
+        self.prefix_store = (
+            PrefixKVStore(int(prefix_cache_mb * (1 << 20)))
+            if prefix_cache_mb > 0 else None
+        )
         self._prefill_jit = jax.jit(
             functools.partial(_prefill_impl, cfg=cfg), donate_argnums=(1,))
         self._decode_jit = jax.jit(
             functools.partial(_decode_impl, cfg=cfg), donate_argnums=(1,))
+        # prefix copy programs: `rows` is static, so one jit wrapper traces
+        # once per bucket-quantized prefix length
+        self._extract_jit = jax.jit(
+            _extract_prefix_impl, static_argnames=("rows",))
+        self._install_jit = jax.jit(_install_prefix_impl, donate_argnums=(0,))
 
     @property
     def n_slots(self) -> int:
         return self.pool.n_slots
 
-    def prefill(
+    @property
+    def chunk_size(self) -> int:
+        """Max tokens one prefill call processes (= prefill_len when
+        chunking is off)."""
+        return self.prefill_chunk or self.prefill_len
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest ladder bucket covering an n-token chunk."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"chunk length {n} exceeds largest bucket {self.buckets[-1]}")
+
+    def prefill_chunk_call(
         self,
         slot: int,
-        prompt_ids: Sequence[int],
+        chunk_ids: Sequence[int],
+        offset: int,
         temperature: float,
         top_k: Optional[int],
         top_p: Optional[float],
         do_sample: bool,
         key: jax.Array,
-    ) -> int:
-        """Prefill ``prompt_ids`` (length <= prefill_len) into ``slot`` and
-        return the first sampled/greedy token."""
-        n = len(prompt_ids)
-        if not (1 <= n <= self.prefill_len):
+    ) -> Tuple[int, int]:
+        """Prefill ``chunk_ids`` into ``slot`` at absolute ``offset``.
+        Returns (sampled token at the chunk's last real position — only
+        meaningful on a prompt's final chunk — and the padded bucket
+        length actually forwarded)."""
+        n = len(chunk_ids)
+        if n < 1:
+            raise ValueError("empty prefill chunk")
+        bucket = self.bucket_for(n)
+        if offset + bucket > self.cfg.block_size:
             raise ValueError(
-                f"prompt length {n} outside [1, {self.prefill_len}] "
-                "(the scheduler crops before calling)"
+                f"chunk bucket {bucket} at offset {offset} overruns the "
+                f"{self.cfg.block_size} cache window (the scheduler "
+                "shifts the final chunk back to keep buckets in-window)"
             )
-        prompt = np.zeros(self.prefill_len, np.int32)
-        prompt[:n] = np.asarray(prompt_ids, np.int32)
-        first, cache = self._prefill_jit(
-            self.params, self.pool.cache, jnp.asarray(prompt),
-            np.int32(n), np.int32(slot),
+        padded = np.zeros(bucket, np.int32)
+        padded[:n] = np.asarray(chunk_ids, np.int32)
+        tok, cache = self._prefill_jit(
+            self.params, self.pool.cache, jnp.asarray(padded),
+            np.int32(n), np.int32(offset), np.int32(slot),
             np.float32(temperature),
             np.int32(0 if top_k is None else top_k),
             np.float32(1.0 if top_p is None else top_p),
             np.bool_(do_sample), key,
         )
         self.pool.cache = cache
-        return int(jax.device_get(first))
+        return int(jax.device_get(tok)), bucket
+
+    # -- shared-prefix KV reuse ----------------------------------------
+    def quantized_prefix_len(self, prompt_len: int) -> int:
+        """Rows worth storing for an n-token prompt: the largest bucket
+        <= prompt_len - 1 (a hit must leave >= 1 tail token to prefill,
+        because the first sampled token needs the last prompt position's
+        logits). 0 = too short to store."""
+        best = 0
+        for b in self.buckets:
+            if b <= prompt_len - 1:
+                best = b
+        return best
+
+    def try_load_prefix(self, slot: int, prompt_ids: Sequence[int]) -> int:
+        """Install the longest stored prefix of ``prompt_ids`` into
+        ``slot`` (device-side row copy, no recompute). Returns the number
+        of rows installed (0 = miss / store disabled)."""
+        if self.prefix_store is None:
+            return 0
+        entry = self.prefix_store.lookup(tuple(prompt_ids))
+        if entry is None:
+            return 0
+        rows, (ek, ev) = entry
+        self.pool.cache = self._install_jit(
+            self.pool.cache, ek, ev, np.int32(slot))
+        return rows
+
+    def save_prefix(self, slot: int, prompt_ids: Sequence[int]) -> int:
+        """After a slot finished prefilling ``prompt_ids``, copy its
+        bucket-quantized leading rows into the prefix store. Returns rows
+        stored (0 = skipped: disabled, too short, or already present)."""
+        if self.prefix_store is None:
+            return 0
+        rows = self.quantized_prefix_len(len(prompt_ids))
+        if rows == 0:
+            return 0
+        key = tuple(prompt_ids[:rows])
+        if self.prefix_store.contains(key):
+            return 0
+        lane = self._extract_jit(self.pool.cache, np.int32(slot), rows=rows)
+        stored = self.prefix_store.insert(key, (lane["k"], lane["v"]))
+        return rows if stored else 0
+
+    # -- warmup --------------------------------------------------------
+    def warmup(self) -> None:
+        """Pre-trace the full program family so no request pays a compile:
+        one prefill per ladder bucket, the decode step, and (when the
+        prefix store is on) the copy programs per storable bucket. Safe
+        only while the pool has no tenants — warmup scribbles over slot
+        0's cache rows, which the stale-row invariant makes harmless."""
+        assert self.pool.used_count == 0, "warmup requires an empty pool"
+        key = jax.random.key(0)
+        for b in self.buckets:
+            self.prefill_chunk_call(
+                0, [0] * b, 0, 1.0, None, None, False, key)
+        s = self.n_slots
+        self.decode_step(
+            np.zeros(s, np.int32),
+            np.full(s, self.cfg.block_size - 1, np.int32),
+            np.ones(s, np.float32), np.zeros(s, np.int32),
+            np.ones(s, np.float32), np.zeros(s, bool),
+            jnp.stack([key] * s),
+        )
+        if self.prefix_store is not None:
+            for b in self.buckets:
+                if b <= self.prefill_len - 1:
+                    lane = self._extract_jit(
+                        self.pool.cache, np.int32(0), rows=b)
+                    self.pool.cache = self._install_jit(
+                        self.pool.cache, lane["k"], lane["v"], np.int32(0))
 
     def decode_step(
         self,
@@ -216,9 +417,13 @@ class DecodeEngine:
         return np.asarray(jax.device_get(nxt))
 
     def compile_counts(self) -> Dict[str, int]:
-        """Number of distinct traces compiled per program — stays at 1 each
-        after warmup no matter how many requests are served."""
+        """Distinct traces per program family. After warmup: decode 1,
+        prefill <= len(self.buckets), prefix copies <= len(self.buckets)
+        each — bounded for the server's lifetime no matter how many
+        requests are served."""
         return {
             "prefill": self._prefill_jit._cache_size(),
             "decode": self._decode_jit._cache_size(),
+            "prefix_load": self._install_jit._cache_size(),
+            "prefix_save": self._extract_jit._cache_size(),
         }

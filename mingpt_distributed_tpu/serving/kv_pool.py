@@ -1,23 +1,32 @@
-"""Slot-based KV-cache pool for continuous batching.
+"""Slot-based KV-cache pool + shared-prefix KV store.
 
 One fixed ``(n_layer, n_slots, block_size, kv_heads, head_dim)`` pair of
 K/V buffers — ``models/generate.init_cache`` with the batch axis
 reinterpreted as a *slot* axis. Each slot holds one in-flight request's
 cache; a request is admitted by prefilling its prompt into a free slot
-(which overwrites the slot's full length, so stale K/V from the previous
-tenant can never leak into attention) and retired by returning the slot to
-the free list. The buffers themselves never change shape or owner-visible
-identity, which is what lets the decode program stay compiled once for the
-server's lifetime.
+and retired by returning the slot to the free list. Stale K/V from a
+previous tenant never leaks into attention because masking is positional
+and every writer fills a row with real data before the first query that
+could see it (the stale-row invariant, serving/engine.py). The buffers
+themselves never change shape or owner-visible identity, which is what
+lets the decode program stay compiled once for the server's lifetime.
 
 Allocation is deterministic (lowest free index first) so a given arrival
 order always produces the same slot placement — the scheduler tests rely
 on replayability.
+
+``PrefixKVStore`` is the byte-bounded LRU behind shared-prefix reuse
+(the system-prompt case): entries are device-resident ``(L, 1, P, KV,
+hd)`` K/V row blocks keyed by the exact token tuple they encode, with P
+quantized to the engine's bucket ladder so the copy programs stay a
+bounded compile family. A request whose prompt extends a stored entry
+copies its rows instead of recomputing them and prefills only the tail.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+from collections import OrderedDict
+from typing import List, Optional, Tuple
 
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.models.generate import Cache, init_cache
@@ -62,3 +71,69 @@ class SlotKVPool:
             raise ValueError(f"slot {slot} is already free (double free)")
         self._free.append(slot)
         self._free.sort()
+
+
+class PrefixKVStore:
+    """Bounded LRU of shared-prefix KV entries.
+
+    Keys are exact token tuples (the prefix the rows encode — hashing the
+    tokens themselves, so a hit can never alias two different prefixes);
+    values are device-array ``(k, v)`` pairs of shape (L, 1, P, KV, hd)
+    with P = len(key). ``capacity_bytes`` bounds the sum of entry sizes;
+    inserting past it evicts least-recently-used entries first. An entry
+    larger than the whole budget is refused rather than thrashing the
+    store empty.
+    """
+
+    def __init__(self, capacity_bytes: int):
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes must be >= 1, got {capacity_bytes}")
+        self.capacity_bytes = capacity_bytes
+        self.used_bytes = 0
+        self._entries: "OrderedDict[Tuple[int, ...], tuple]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def contains(self, key: Tuple[int, ...]) -> bool:
+        return key in self._entries
+
+    @staticmethod
+    def _nbytes(kv) -> int:
+        return int(kv[0].nbytes) + int(kv[1].nbytes)
+
+    def lookup(self, tokens: Tuple[int, ...]):
+        """Longest stored entry that is a *proper* prefix of ``tokens``
+        (P < len(tokens): the tail must keep >= 1 token to prefill, since
+        the first sampled token needs the last prompt position's logits).
+        Returns (rows, (k, v)) or None; a hit refreshes LRU order."""
+        best_key = None
+        for key in self._entries:
+            p = len(key)
+            if p < len(tokens) and tokens[:p] == key:
+                if best_key is None or p > len(best_key):
+                    best_key = key
+        if best_key is None:
+            return None
+        self._entries.move_to_end(best_key)
+        return len(best_key), self._entries[best_key]
+
+    def insert(self, key: Tuple[int, ...], kv) -> bool:
+        """Store rows for ``key``; evict LRU entries until it fits.
+        Returns False when the entry alone exceeds the byte budget or the
+        key is already present (refreshed, not replaced — the rows are
+        deterministic functions of the tokens, so old is as good as new).
+        """
+        if key in self._entries:
+            self._entries.move_to_end(key)
+            return False
+        need = self._nbytes(kv)
+        if need > self.capacity_bytes:
+            return False
+        while self.used_bytes + need > self.capacity_bytes:
+            _, old = self._entries.popitem(last=False)
+            self.used_bytes -= self._nbytes(old)
+        self._entries[key] = kv
+        self.used_bytes += need
+        return True

@@ -35,9 +35,25 @@ class ServingMetrics:
         self.prefills = 0
         self.tokens_generated = 0
         self.steps = 0
+        # prefill accounting (ISSUE 3): real prompt tokens forwarded, the
+        # padded bucket histogram (how well the ladder fits the traffic),
+        # and wall time spent inside prefill calls — the decode-stall
+        # budget admissions consume
+        self.prefill_chunks = 0
+        self.prefill_tokens = 0          # real (unpadded) prompt tokens
+        self.prefill_padded_tokens = 0   # bucket lengths actually forwarded
+        self.bucket_histogram: Dict[int, int] = {}
+        self._prefill_time_s = 0.0
+        self._prefill_rate = RateWindow()
+        self._prefill_tokens_per_sec: Optional[float] = None
+        # shared-prefix store
+        self.prefix_lookups = 0
+        self.prefix_hits = 0
+        self.prefix_rows_reused = 0
         # latency accumulators (seconds)
         self._ttft_sum = 0.0
         self._ttft_count = 0
+        self._stall_sum = 0.0            # per-admission slot-claim → first token
         self._itl_sum = 0.0
         self._itl_count = 0
         # gauges sampled at step boundaries
@@ -60,10 +76,34 @@ class ServingMetrics:
     def on_error(self) -> None:
         self.requests_failed += 1
 
-    def on_prefill(self, ttft_s: float) -> None:
+    def on_prefill(self, ttft_s: float, stall_s: float = 0.0) -> None:
+        """One admission finished prefilling. ``stall_s`` is the wall time
+        from slot claim to first token — what this admission cost its
+        co-tenants in decode stall."""
         self.prefills += 1
         self._ttft_sum += ttft_s
         self._ttft_count += 1
+        self._stall_sum += stall_s
+
+    def on_prefill_chunk(self, n_tokens: int, bucket: int, seconds: float) -> None:
+        """One prefill call: ``n_tokens`` real prompt tokens forwarded as
+        a ``bucket``-length padded chunk."""
+        self.prefill_chunks += 1
+        self.prefill_tokens += n_tokens
+        self.prefill_padded_tokens += bucket
+        self.bucket_histogram[bucket] = self.bucket_histogram.get(bucket, 0) + 1
+        self._prefill_time_s += seconds
+        rate = self._prefill_rate.observe(self.prefill_tokens)
+        if rate is not None:
+            self._prefill_tokens_per_sec = rate
+
+    def on_prefix_lookup(self, hit: bool, rows: int, enabled: bool = True) -> None:
+        if not enabled:
+            return
+        self.prefix_lookups += 1
+        if hit:
+            self.prefix_hits += 1
+            self.prefix_rows_reused += rows
 
     def on_tokens(self, n: int) -> None:
         self.tokens_generated += n
@@ -102,6 +142,25 @@ class ServingMetrics:
         return self._itl_sum / self._itl_count if self._itl_count else None
 
     @property
+    def admission_stall_mean_s(self) -> Optional[float]:
+        return self._stall_sum / self.prefills if self.prefills else None
+
+    @property
+    def prefix_hit_rate(self) -> Optional[float]:
+        if not self.prefix_lookups:
+            return None
+        return self.prefix_hits / self.prefix_lookups
+
+    @property
+    def prefill_pad_overhead(self) -> Optional[float]:
+        """Padded-to-real token ratio — 1.0 means the ladder fits the
+        traffic perfectly; the redundant-overlap rows of shifted final
+        chunks count as padding here too."""
+        if not self.prefill_tokens:
+            return None
+        return self.prefill_padded_tokens / self.prefill_tokens
+
+    @property
     def slot_utilization(self) -> Optional[float]:
         return self._util_sum / self.steps if self.steps else None
 
@@ -122,10 +181,15 @@ class ServingMetrics:
             )
         if self._tokens_per_sec is not None:
             parts.append(f"tokens/sec {self._tokens_per_sec:.4g}")
+        if self._prefill_tokens_per_sec is not None:
+            parts.append(f"prefill_tok/s {self._prefill_tokens_per_sec:.4g}")
         if self.ttft_mean_s is not None:
             parts.append(f"ttft_ms {self.ttft_mean_s * 1e3:.4g}")
         if self.itl_mean_s is not None:
             parts.append(f"itl_ms {self.itl_mean_s * 1e3:.4g}")
+        if self.prefix_lookups:
+            parts.append(
+                f"prefix_hit {self.prefix_hits}/{self.prefix_lookups}")
         return " | ".join(parts)
 
     def summary(self) -> Dict[str, Any]:
@@ -136,6 +200,20 @@ class ServingMetrics:
             "requests_expired": self.requests_expired,
             "requests_failed": self.requests_failed,
             "prefills": self.prefills,
+            "prefill_chunks": self.prefill_chunks,
+            "prefill_tokens": self.prefill_tokens,
+            "prefill_padded_tokens": self.prefill_padded_tokens,
+            "prefill_pad_overhead": self.prefill_pad_overhead,
+            "prefill_time_s": self._prefill_time_s,
+            "prefill_tokens_per_sec": self._prefill_tokens_per_sec,
+            "bucket_histogram": {
+                str(k): v for k, v in sorted(self.bucket_histogram.items())
+            },
+            "admission_stall_mean_s": self.admission_stall_mean_s,
+            "prefix_lookups": self.prefix_lookups,
+            "prefix_hits": self.prefix_hits,
+            "prefix_hit_rate": self.prefix_hit_rate,
+            "prefix_rows_reused": self.prefix_rows_reused,
             "tokens_generated": self.tokens_generated,
             "steps": self.steps,
             "queue_depth": self.queue_depth,

@@ -3,12 +3,23 @@
 The loop the server runs (``step()`` = one scheduling round):
 
 1. **Admit** — while the queue is non-empty and the pool has a free slot,
-   pop FIFO, prefill the prompt into the slot (one compiled call, traced
-   slot index), sample the request's first token, start streaming.
-2. **Decode** — one shared compiled step advances *every* slot one token
-   (per-slot positions and sampling params; inactive lanes compute into
-   their own dead cache rows and are ignored host-side).
-3. **Retire** — requests hitting a stop condition (per-request
+   pop FIFO, claim the slot, and try a shared-prefix cache hit (device
+   row copy — the prompt's cached head costs no FLOPs, only the tail is
+   prefilled).
+2. **Prefill** — every slot still prefilling advances by at most ONE
+   chunk of <= ``prefill_chunk`` tokens (padded to the smallest covering
+   bucket of the engine's compiled ladder). Short prompts finish in the
+   same round they were admitted — identical latency to the old
+   whole-prompt admission — while a long prompt spreads its chunks
+   across rounds so co-tenant inter-token latency is bounded by one
+   chunk, not one full prompt. The final chunk samples the request's
+   first token and flips the slot to decoding.
+3. **Decode** — one shared compiled step advances every *decoding* slot
+   one token (per-slot positions and sampling params; prefilling and
+   free lanes ride along parked at position block_size-1, a row the
+   stale-row invariant makes unobservable until its legitimate writer
+   fills it).
+4. **Retire** — requests hitting a stop condition (per-request
    ``max_new_tokens`` or EOS token) finish, free their slot, and the next
    round's admissions reuse it. Mid-decode admission is the whole point:
    new prompts join while others are half-way through decoding.
@@ -17,8 +28,11 @@ Determinism: FIFO admission, lowest-free-slot placement, and per-request
 PRNG keys derived as ``fold_in(key(seed), token_index)`` — a sampled
 request's output depends only on (params, prompt, sampling params, seed),
 never on which other requests share the batch. Greedy requests are
-token-identical to solo ``generate()`` on the same prompt (asserted in
-tests/test_serving.py).
+token-identical to solo ``generate()`` on the same prompt under every
+combination of bucketing, chunking and prefix reuse (asserted in
+tests/test_serving.py): chunked prefill is row-equivalent to the
+one-shot forward, and prefix rows are bit-identical to what recomputing
+them would produce.
 
 Prompt bounds: prompts longer than ``prefill_len`` are cropped to their
 last ``prefill_len`` tokens (the server has no sliding-window decode path
@@ -33,8 +47,8 @@ Robustness under sustained traffic (ISSUE 2):
   act on) instead of growing the deque without bound;
 * **deadlines** — a per-request ``deadline_s`` (or the server-wide
   ``default_deadline_s``) expires requests at step boundaries, whether
-  still queued or mid-decode, so an abandoned request can never pin a KV
-  slot forever (``finish_reason="deadline"``);
+  still queued, mid-prefill or mid-decode, so an abandoned request can
+  never pin a KV slot forever (``finish_reason="deadline"``);
 * **callback isolation** — a raising ``on_token`` callback retires the
   request and frees its slot (``finish_reason="error"``, the exception
   on ``handle.error``) instead of leaking the slot or tearing down the
@@ -110,6 +124,12 @@ class RequestHandle:
     error: Optional[BaseException] = None  # a raising on_token callback
     first_token_time: Optional[float] = None
     last_token_time: Optional[float] = None
+    # admission progress: cache rows [0, prefill_pos) of the slot hold
+    # this request's prompt (prefix-hit rows + completed chunks)
+    prefilling: bool = False
+    prefill_pos: int = 0
+    prefix_rows: int = 0          # rows served from the shared-prefix store
+    admit_time: Optional[float] = None
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -133,9 +153,17 @@ class InferenceServer:
         max_queue: Optional[int] = None,
         default_deadline_s: Optional[float] = None,
         clock: Callable[[], float] = time.perf_counter,
+        prefill_buckets: Optional[Sequence[int]] = None,
+        prefill_chunk: Optional[int] = None,
+        prefix_cache_mb: float = 0.0,
+        warmup: bool = False,
     ):
         self.cfg = cfg
-        self.engine = DecodeEngine(params, cfg, n_slots, prefill_len)
+        self.engine = DecodeEngine(
+            params, cfg, n_slots, prefill_len,
+            prefill_buckets=prefill_buckets, prefill_chunk=prefill_chunk,
+            prefix_cache_mb=prefix_cache_mb,
+        )
         self.metrics = metrics or ServingMetrics(n_slots, log_every=log_every)
         self.on_token = on_token
         if max_queue is not None and max_queue < 1:
@@ -146,15 +174,24 @@ class InferenceServer:
         self.queue: Deque[RequestHandle] = deque()
         self._slots: List[Optional[RequestHandle]] = [None] * n_slots
         self._ids = itertools.count()
-        # per-slot decode-state arrays (host side, fed to the engine whole)
+        # per-slot decode-state arrays (host side, fed to the engine whole).
+        # Non-decoding lanes (free or still prefilling) are PARKED at
+        # position block_size-1: the shared decode program writes one row
+        # per slot unconditionally, and that row is the only one a later
+        # legitimate writer is guaranteed to refill before any query can
+        # attend it — parking anywhere lower could clobber rows a chunked
+        # prefill has already written.
+        self._parked = cfg.block_size - 1
         self._tokens = np.zeros(n_slots, np.int32)
-        self._positions = np.zeros(n_slots, np.int32)
+        self._positions = np.full(n_slots, self._parked, np.int32)
         self._temps = np.ones(n_slots, np.float32)
         self._top_ks = np.zeros(n_slots, np.int32)
         self._top_ps = np.ones(n_slots, np.float32)
         self._do_sample = np.zeros(n_slots, bool)
         self._keys: List[jax.Array] = [jax.random.key(0)] * n_slots
         self._req_keys: List[Optional[jax.Array]] = [None] * n_slots
+        if warmup:
+            self.engine.warmup()
 
     # -- submission ----------------------------------------------------
     def submit(self, request: Request) -> RequestHandle:
@@ -225,8 +262,10 @@ class InferenceServer:
         slot = handle.slot
         if slot is not None:
             handle.slot = None
+            handle.prefilling = False
             self._slots[slot] = None
             self._req_keys[slot] = None
+            self._positions[slot] = self._parked
             self.engine.pool.free(slot)
 
     def _retire(self, handle: RequestHandle) -> None:
@@ -237,8 +276,9 @@ class InferenceServer:
         self.metrics.on_complete(len(handle.tokens), span)
 
     def _fail(self, handle: RequestHandle, reason: str) -> None:
-        """Terminal non-success: deadline expiry (queued or mid-decode) or
-        a raising callback. Frees the slot so it can never stay pinned."""
+        """Terminal non-success: deadline expiry (queued, mid-prefill or
+        mid-decode) or a raising callback. Frees the slot so it can never
+        stay pinned."""
         handle.finished = True
         handle.finish_reason = reason
         self._release_slot(handle)
@@ -254,35 +294,76 @@ class InferenceServer:
         return False
 
     def _admit(self, handle: RequestHandle) -> None:
+        """Claim a slot and start admission: a shared-prefix hit installs
+        its rows now (device copy); prompt tokens beyond it prefill in the
+        chunk phase — same round for short prompts, spread over rounds
+        for long ones."""
         slot = self.engine.pool.allocate()
         assert slot is not None
         req = handle.request
         handle.slot = slot
+        handle.prefilling = True
+        handle.admit_time = self.clock()
         self._slots[slot] = handle
-        req_key = jax.random.key(req.seed)
-        self._req_keys[slot] = req_key
-        first = self.engine.prefill(
-            slot, handle.prompt_used,
+        self._req_keys[slot] = jax.random.key(req.seed)
+        hit = self.engine.try_load_prefix(slot, handle.prompt_used)
+        self.metrics.on_prefix_lookup(
+            hit > 0, hit, enabled=self.engine.prefix_store is not None)
+        handle.prefix_rows = hit
+        handle.prefill_pos = hit
+
+    def _prefill_one_chunk(self, handle: RequestHandle) -> None:
+        """Advance a prefilling slot by one chunk; the final chunk samples
+        the request's first token and flips the slot to decoding."""
+        req = handle.request
+        slot = handle.slot
+        prompt = handle.prompt_used
+        n_total = len(prompt)
+        pos = handle.prefill_pos
+        take = min(n_total - pos, self.engine.chunk_size)
+        end = pos + take
+        last = end == n_total
+        off = pos
+        bucket = self.engine.bucket_for(take)
+        if off + bucket > self.cfg.block_size:
+            # the final bucket would overrun the cache window: shift the
+            # chunk window back and re-prefill the overlap. Rewriting rows
+            # with the values they already hold is exact (the forward is
+            # deterministic and row-wise), so parity is unaffected — we
+            # trade a few redundant row-FLOPs for a bounded program count.
+            off = self.cfg.block_size - bucket
+        t0 = self.clock()
+        tok, padded = self.engine.prefill_chunk_call(
+            slot, prompt[off:end], off,
             req.temperature, req.top_k, req.top_p, req.do_sample,
-            jax.random.fold_in(req_key, 0),
+            jax.random.fold_in(self._req_keys[slot], 0),
         )
-        ok = self._emit(handle, first)
-        self.metrics.on_prefill(handle.ttft_s or 0.0)
+        self.metrics.on_prefill_chunk(end - pos, padded, self.clock() - t0)
+        handle.prefill_pos = end
+        if not last:
+            return
+        handle.prefilling = False
+        if self.engine.prefix_store is not None:
+            self.engine.save_prefix(slot, prompt)
+        ok = self._emit(handle, tok)
+        now = self.clock()
+        self.metrics.on_prefill(
+            handle.ttft_s or 0.0, now - (handle.admit_time or now))
         # slot decode state: the first token is fed at position len(prompt)
-        self._tokens[slot] = first
-        self._positions[slot] = len(handle.prompt_used)
+        self._tokens[slot] = tok
+        self._positions[slot] = n_total
         self._temps[slot] = req.temperature
         self._top_ks[slot] = 0 if req.top_k is None else req.top_k
         self._top_ps[slot] = 1.0 if req.top_p is None else req.top_p
         self._do_sample[slot] = req.do_sample
         if not ok:
             self._fail(handle, "error")
-        elif self._check_stop(handle, first):
+        elif self._check_stop(handle, tok):
             self._retire(handle)
 
     def step(self) -> bool:
-        """One scheduling round (expire → admit → decode → retire).
-        Returns True while any request is queued or in flight."""
+        """One scheduling round (expire → admit → prefill chunks → decode
+        → retire). Returns True while any request is queued or in flight."""
         # deadline sweep first: expired queued requests never take a slot,
         # expired in-flight requests release theirs before admission
         now = self.clock()
@@ -297,7 +378,15 @@ class InferenceServer:
         while self.queue and self.engine.pool.free_count:
             self._admit(self.queue.popleft())
 
-        active = [s for s, h in enumerate(self._slots) if h is not None]
+        # one chunk per prefilling slot per round: a long prompt's
+        # admission cost is spread out, so co-tenant inter-token latency
+        # is bounded by one chunk forward, not one full-prompt forward
+        for h in list(self._slots):
+            if h is not None and h.prefilling:
+                self._prefill_one_chunk(h)
+
+        active = [s for s, h in enumerate(self._slots)
+                  if h is not None and not h.prefilling]
         if active:
             for s in active:
                 handle = self._slots[s]

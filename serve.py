@@ -34,6 +34,15 @@ growing without bound); --deadline-s S expires requests that exceed
 their deadline, queued or mid-decode, so an abandoned request can't pin
 a KV slot. One failing prompt (encode error, validation error, queue
 rejection) is reported and skipped — the engine keeps serving.
+
+Prefill knobs (ISSUE 3): --prefill-buckets "64,128,..." compiles a
+bounded ladder of prefill lengths (default: powers of two from 64) so a
+short prompt pays a short forward instead of a block_size² one;
+--prefill-chunk N prefills long prompts in N-token chunks between decode
+steps, bounding co-tenant inter-token latency by one chunk;
+--prefix-cache-mb M keeps an LRU of shared-prefix KV rows so a request
+repeating a cached prompt head (system prompts) copies rows instead of
+recomputing them; --warmup pre-traces the whole ladder at start.
 """
 
 from __future__ import annotations
@@ -74,8 +83,44 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--deadline-s", type=float, default=None,
                    help="per-request deadline in seconds; expired requests "
                         "free their KV slot (finish_reason=deadline)")
+    p.add_argument("--prefill-buckets", default=None,
+                   help="comma-separated ladder of compiled prefill "
+                        "lengths (default: powers of two from 64 up to "
+                        "block_size); prompts pad to the smallest "
+                        "covering bucket")
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="prefill long prompts in chunks of this many "
+                        "tokens between decode steps (default: whole "
+                        "prompt in one call)")
+    p.add_argument("--prefix-cache-mb", type=float, default=0.0,
+                   help="LRU budget (MiB) for shared-prefix KV reuse; "
+                        "0 disables the prefix store")
+    p.add_argument("--warmup", action="store_true",
+                   help="pre-trace the prefill bucket ladder and decode "
+                        "step before serving (no first-request compile "
+                        "stall)")
     p.add_argument("overrides", nargs="*")
     return p
+
+
+def _parse_buckets(spec):
+    if spec is None:
+        return None
+    try:
+        return tuple(int(b) for b in str(spec).split(",") if b.strip())
+    except ValueError:
+        raise SystemExit(f"--prefill-buckets must be comma-separated ints, "
+                         f"got {spec!r}")
+
+
+def _server_kwargs(args) -> dict:
+    """The prefill-overhaul knobs, shared by every server construction."""
+    return dict(
+        prefill_buckets=_parse_buckets(args.prefill_buckets),
+        prefill_chunk=args.prefill_chunk,
+        prefix_cache_mb=args.prefix_cache_mb,
+        warmup=args.warmup,
+    )
 
 
 def _request_for(args, tokens, eos_id=None):
@@ -95,11 +140,14 @@ def _request_for(args, tokens, eos_id=None):
 
 
 def selftest(args) -> int:
-    """Offline batch over 3 canned prompts with a random-init tiny model:
+    """Offline batch over canned prompts with a random-init tiny model:
     greedy server output must be token-identical to solo generate(), with
-    both compiled programs traced exactly once. CI runs this via
-    run_tests.sh so the server is exercised end-to-end without a
-    checkpoint."""
+    the compiled-program family bounded by the bucket ladder. CI runs
+    this twice via run_tests.sh — once with defaults (single-bucket
+    ladder: exactly one prefill + one decode trace) and once with
+    --prefill-chunk/--prefill-buckets/--prefix-cache-mb so chunked +
+    bucketed admission and prefix reuse are exercised end-to-end without
+    a checkpoint."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -116,10 +164,15 @@ def selftest(args) -> int:
     params = gpt.init(jax.random.key(0), cfg)
     canned = ["O God, O God!", "Once more unto", "All the world's"]
     prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    if args.prefix_cache_mb > 0:
+        # two prompts sharing a long head: the second must hit the store
+        canned += ["Once more unto the breach", "Once more unto the wall!"]
+        prompts += [[ord(c) % cfg.vocab_size for c in s] for s in canned[-2:]]
     max_new = 12
 
     server = InferenceServer(params, cfg, n_slots=2,
-                             log_every=args.log_every)
+                             log_every=args.log_every,
+                             **_server_kwargs(args))
     handles = server.generate_batch(
         [Request(prompt=p, max_new_tokens=max_new) for p in prompts])
 
@@ -134,8 +187,13 @@ def selftest(args) -> int:
         if not ok:
             rc = 1
     counts = server.compile_counts()
-    if counts != {"prefill": 1, "decode": 1}:
-        print(f"selftest FAIL: recompilation after warmup: {counts}")
+    ladder = len(server.engine.buckets)
+    if counts["decode"] != 1 or counts["prefill"] > ladder:
+        print(f"selftest FAIL: unbounded compilation: {counts} "
+              f"(ladder size {ladder})")
+        rc = 1
+    if args.prefix_cache_mb > 0 and server.metrics.prefix_hits < 1:
+        print("selftest FAIL: prefix store enabled but no hit recorded")
         rc = 1
     summary = server.summary()
     print("selftest metrics:", json.dumps(summary))
@@ -202,7 +260,8 @@ def main(argv=None) -> int:
         server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
                                  log_every=args.log_every,
                                  max_queue=args.queue_limit,
-                                 default_deadline_s=args.deadline_s)
+                                 default_deadline_s=args.deadline_s,
+                                 **_server_kwargs(args))
         # per-request isolation: one bad prompt (encode failure, validation
         # error, queue rejection) is reported and skipped — the batch keeps
         # draining instead of the whole engine tearing down
@@ -229,7 +288,8 @@ def main(argv=None) -> int:
     server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
                              on_token=on_token, log_every=0,
                              max_queue=args.queue_limit,
-                             default_deadline_s=args.deadline_s)
+                             default_deadline_s=args.deadline_s,
+                             **_server_kwargs(args))
     interactive = sys.stdin.isatty()
     if interactive:
         print("prompt> ", end="", flush=True)

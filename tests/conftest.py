@@ -18,6 +18,18 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Persistent compilation cache (same as run_tests.sh): the suite is
+# compile-dominated, and the cache pays off twice — across runs, and
+# WITHIN one run wherever distinct jit wrappers lower identical programs
+# (every serving test builds its own engine whose prefill/decode programs
+# are byte-identical across tests). Safe to delete the directory anytime.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_test_cache"),
+)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+
 import jax  # noqa: E402
 
 jax.config.update("jax_threefry_partitionable", True)

@@ -112,7 +112,10 @@ def test_mid_decode_admission_matches_solo_and_never_recompiles(cfg_params):
     h4 = server.submit(Request(prompt=PROMPTS[3], max_new_tokens=4))
     server.run_until_drained(max_steps=100)
     assert h4.tokens == solo_greedy(params, cfg, PROMPTS[3], 4)
-    assert server.compile_counts() == {"prefill": 1, "decode": 1}
+    # default ladder at block_size=32 is a single bucket: still one
+    # prefill trace, one decode trace, no prefix-copy programs
+    assert server.compile_counts() == {
+        "prefill": 1, "decode": 1, "prefix_load": 0, "prefix_save": 0}
 
 
 def test_per_request_stop_conditions(cfg_params):
@@ -305,6 +308,224 @@ def test_raising_callback_frees_slot_and_server_keeps_serving(cfg_params):
     h_ok = server.submit(Request(prompt=PROMPTS[1], max_new_tokens=6))
     server.run_until_drained(max_steps=100)
     assert h_ok.tokens == solo_greedy(params, cfg, PROMPTS[1], 6)
+
+
+# ---------------------------------------------------------------------------
+# prefill overhaul (ISSUE 3): bucket ladder, chunked prefill, prefix reuse
+# ---------------------------------------------------------------------------
+
+
+MIXED_PROMPTS = [
+    list(range(1, 4)),                     # 3 tokens  -> bucket 4
+    list(range(5, 12)),                    # 7 tokens  -> bucket 8
+    list(range(2, 15)),                    # 13 tokens -> bucket 16
+    list(range(3, 25)),                    # 22 tokens -> bucket 32
+    [9, 8, 7, 6, 5],                       # 5 tokens  -> bucket 8
+    list(range(10, 40)),                   # 30 tokens -> bucket 32
+]
+
+
+def test_bucket_ladder_trace_count_bounded_with_warmup(cfg_params):
+    """The acceptance trace-count assert: warmup pre-traces exactly the
+    ladder, admitting prompts of mixed lengths compiles nothing further
+    (<= ladder-size prefill programs + 1 decode for the server's
+    lifetime), every greedy output stays solo-exact, and short prompts
+    are forwarded at their bucket length, not block_size."""
+    cfg, params = cfg_params
+    buckets = (4, 8, 16, 32)
+    server = InferenceServer(params, cfg, n_slots=2, prefill_buckets=buckets,
+                             warmup=True)
+    assert server.engine.buckets == buckets
+    counts = server.compile_counts()
+    assert counts == {"prefill": len(buckets), "decode": 1,
+                      "prefix_load": 0, "prefix_save": 0}
+    # cap max_new so prompt+new fits the window (the server has no
+    # sliding-window decode path to compare against)
+    n_for = {id(p): min(5, cfg.block_size - len(p)) for p in MIXED_PROMPTS}
+    handles = server.generate_batch(
+        [Request(prompt=p, max_new_tokens=n_for[id(p)])
+         for p in MIXED_PROMPTS])
+    for p, h in zip(MIXED_PROMPTS, handles):
+        assert h.tokens == solo_greedy(params, cfg, p, n_for[id(p)]), \
+            h.request_id
+    # a 3-token prompt paid a 4-token forward, not a 32-token one
+    hist = server.metrics.bucket_histogram
+    assert hist.get(4) and hist.get(32)
+    # warmup saw every shape: serving the whole mix compiled nothing new
+    assert server.compile_counts() == counts
+
+
+def test_chunked_prefill_staggered_admission_parity(cfg_params):
+    """A long prompt admitted mid-decode prefills in chunks across
+    scheduler rounds while the co-tenant keeps decoding — the decode
+    batch advances one token EVERY chunked round (inter-token latency
+    bounded by one chunk, not one prompt) and both outputs stay
+    token-identical to solo generate()."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=2,
+                             prefill_buckets=(4, 8, 16, 32), prefill_chunk=8)
+    short = PROMPTS[0]
+    long_p = MIXED_PROMPTS[5]  # 30 tokens -> 4 chunks of <= 8
+    h1 = server.submit(Request(prompt=short, max_new_tokens=10))
+    server.step()
+    server.step()  # h1 mid-decode
+    h2 = server.submit(Request(prompt=long_p, max_new_tokens=2))
+    progress = []
+    while not h2.tokens and len(progress) < 50:  # until h2's first token
+        before = len(h1.tokens)
+        server.step()
+        progress.append(len(h1.tokens) - before)
+    # every admission/chunk round also advanced the decoding co-tenant
+    assert len(progress) >= 4 and all(d == 1 for d in progress)
+    server.run_until_drained(max_steps=100)
+    assert h2.tokens == solo_greedy(params, cfg, long_p, 2)
+    assert h1.tokens == solo_greedy(params, cfg, short, 10)
+    assert server.metrics.prefill_chunks >= 4 + 1
+
+
+def test_prefix_reuse_hits_and_stays_token_identical(cfg_params):
+    """The system-prompt case: a second request sharing a >= bucket-sized
+    prefix copies those KV rows (no recompute) and prefills only the
+    tail; its greedy output must stay solo-exact. Also the edge where the
+    hit covers everything but one token — the tail must still be
+    prefilled because the first sampled token needs the last prompt
+    position's logits."""
+    cfg, params = cfg_params
+    system = list(range(1, 17))            # 16 shared tokens
+    a = system + [20, 21, 22]
+    b = system + [30, 31]
+    server = InferenceServer(params, cfg, n_slots=1,
+                             prefill_buckets=(4, 8, 16, 32),
+                             prefix_cache_mb=8.0)
+    ha = server.submit(Request(prompt=a, max_new_tokens=4))
+    server.run_until_drained(max_steps=100)
+    tokens_after_a = server.metrics.prefill_tokens
+    hb = server.submit(Request(prompt=b, max_new_tokens=4))
+    server.run_until_drained(max_steps=100)
+    assert ha.tokens == solo_greedy(params, cfg, a, 4)
+    assert hb.tokens == solo_greedy(params, cfg, b, 4)
+    m = server.metrics
+    assert m.prefix_lookups == 2 and m.prefix_hits == 1
+    assert m.prefix_rows_reused == 16 == hb.prefix_rows
+    # b's admission forwarded only its tail (2 tokens past the hit)
+    assert m.prefill_tokens - tokens_after_a == len(b) - 16
+    assert 0 < m.prefix_hit_rate < 1
+    # one-token tail: prompt == stored prefix + 1 token
+    hc = server.generate_batch(
+        [Request(prompt=system + [41], max_new_tokens=3)])[0]
+    assert hc.prefix_rows == 16
+    assert hc.tokens == solo_greedy(params, cfg, system + [41], 3)
+
+
+def test_all_three_mechanisms_combined_parity(cfg_params):
+    """Acceptance: bucketing + chunking + prefix reuse enabled at once,
+    staggered admissions, mixed greedy/sampled tenants — greedy outputs
+    token-identical to solo generate(), trace counts bounded."""
+    cfg, params = cfg_params
+    buckets = (4, 8, 16, 32)
+    server = InferenceServer(params, cfg, n_slots=2, prefill_buckets=buckets,
+                             prefill_chunk=8, prefix_cache_mb=8.0,
+                             warmup=False)
+    shared = list(range(3, 20))  # 17 tokens: 16 storable
+    reqs = [
+        Request(prompt=shared + [25, 26], max_new_tokens=6),
+        Request(prompt=PROMPTS[0], max_new_tokens=8, do_sample=True,
+                temperature=1.3, top_k=9, seed=5),
+        Request(prompt=shared + [27], max_new_tokens=5),
+        Request(prompt=MIXED_PROMPTS[5], max_new_tokens=2),
+    ]
+    handles = []
+    for r in reqs:
+        handles.append(server.submit(r))
+        server.step()  # staggered: each arrival lands mid-flight
+    server.run_until_drained(max_steps=200)
+    for r, h in zip(reqs, handles):
+        if not r.do_sample:
+            assert h.tokens == solo_greedy(
+                params, cfg, list(r.prompt), r.max_new_tokens), h.request_id
+    assert server.metrics.prefix_hits >= 1
+    counts = server.compile_counts()
+    assert counts["decode"] == 1
+    assert counts["prefill"] <= len(server.engine.buckets) + 1
+    assert counts["prefix_load"] <= len(buckets)
+    assert counts["prefix_save"] <= len(buckets)
+
+
+def test_final_chunk_shift_back_at_window_edge(cfg_params):
+    """When the final chunk's bucket would overrun block_size, the
+    scheduler shifts the chunk window back and re-prefills the overlap —
+    output must stay exact. Ladder (5, 32) + chunk 5 on a 32-token
+    prompt: the last chunk (2 tokens at offset 30) pads to bucket 5,
+    which overruns the window (35 > 32) and must shift back to 27."""
+    cfg, params = cfg_params
+    prompt = list(range(1, 33))  # 32 tokens == block_size
+    server = InferenceServer(params, cfg, n_slots=1,
+                             prefill_buckets=(5, 32), prefill_chunk=5)
+    h = server.submit(Request(prompt=prompt, max_new_tokens=1))
+    server.run_until_drained(max_steps=50)
+    assert h.tokens == solo_greedy(params, cfg, prompt, 1)
+
+
+def test_prefix_store_lru_and_byte_bounds(cfg_params):
+    """PrefixKVStore unit semantics: proper-prefix lookup, longest-match
+    wins, LRU eviction under the byte budget, oversized entries refused."""
+    from mingpt_distributed_tpu.serving import PrefixKVStore
+
+    def entry(rows):
+        a = jnp.zeros((rows,), jnp.float32)
+        return (a, a)  # 8 bytes per row total
+
+    store = PrefixKVStore(capacity_bytes=80)  # room for 10 rows
+    assert store.insert((1, 2, 3), entry(3))          # 24 bytes
+    assert store.insert((1, 2, 3, 4, 5), entry(5))    # +40 = 64
+    # longest proper prefix wins
+    rows, _ = store.lookup((1, 2, 3, 4, 5, 6))
+    assert rows == 5
+    # an exact-length match is NOT a proper prefix of itself (a hit must
+    # leave >= 1 tail token): only the shorter entry qualifies
+    rows, _ = store.lookup((1, 2, 3, 4, 5))
+    assert rows == 3
+    assert store.lookup((9, 9, 9)) is None
+    # inserting 32 more bytes exceeds the 80-byte budget -> evicts the
+    # least recently used entry, which is (1,2,3,4,5)... except both
+    # lookups above refreshed it and (1,2,3) last, so (1,2,3,4,5) goes
+    assert store.insert((7, 8, 9, 10), entry(4))
+    assert not store.contains((1, 2, 3, 4, 5))
+    assert store.contains((1, 2, 3))
+    # an entry bigger than the whole budget is refused outright
+    assert not store.insert((5,) * 20, entry(20))
+    assert store.used_bytes <= store.capacity_bytes
+
+
+def test_prefill_flops_scale_with_bucket(cfg_params):
+    """Acceptance: admission cost tracks prompt length. The compiled
+    small-bucket prefill must cost a fraction of the full-window program
+    (cost_analysis flops), which is also exactly what a prefix-cache hit
+    saves — the tail-only prefill runs the small program."""
+    cfg, params = cfg_params
+    from mingpt_distributed_tpu.serving import DecodeEngine
+
+    engine = DecodeEngine(params, cfg, n_slots=1, prefill_buckets=(4, 32))
+
+    def prefill_flops(bucket):
+        args = (
+            params, engine.pool.cache,
+            jnp.zeros(bucket, jnp.int32), np.int32(1), np.int32(0),
+            np.int32(0), np.float32(1.0), np.int32(0), np.float32(1.0),
+            np.bool_(False), jax.random.key(0),
+        )
+        compiled = engine._prefill_jit.lower(*args).compile()
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):  # older jaxlib returns [dict]
+            cost = cost[0]
+        return cost.get("flops")
+
+    small, full = prefill_flops(4), prefill_flops(32)
+    if small is None or full is None:
+        pytest.skip("backend reports no cost_analysis flops")
+    # 4-token bucket does a 4-row forward; 32-token does 32 rows + the
+    # quadratic attention term — demand at least the linear-term gap
+    assert small < full / 4
 
 
 def test_llama_mode_serving_parity(cfg_params):
