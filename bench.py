@@ -402,11 +402,11 @@ def inner() -> int:
 
     from mingpt_distributed_tpu.config import GPTConfig, OptimizerConfig
     from mingpt_distributed_tpu.models import gpt
-    from mingpt_distributed_tpu.training.metrics import (
-        flops_per_token,
+    from mingpt_distributed_tpu.telemetry import (
         peak_flops_per_chip,
         peak_hbm_bytes_per_chip,
     )
+    from mingpt_distributed_tpu.training.metrics import flops_per_token
     from mingpt_distributed_tpu.training.optimizer import make_optimizer
     from mingpt_distributed_tpu.training.trainer import make_train_step
 

@@ -421,6 +421,16 @@ class TrainerConfig:
     profile_dir: Optional[str] = None   # jax.profiler trace output
     profile_steps: Tuple[int, int] = (10, 20)
     max_steps: Optional[int] = None     # step cap (for benches/smoke runs)
+    # --- telemetry (ISSUE 5) ---------------------------------------------
+    # Serve /metrics (Prometheus text) + /healthz from process 0 on this
+    # port; 0 disables. Negative values are rejected at bind time. Use a
+    # fixed port for scrapers; the serving path's --metrics-port 0 idiom
+    # (ephemeral) is for tests, where TrainerConfig keeps 0 = off because
+    # a training job has no caller to read the bound port back.
+    metrics_port: int = 0
+    # Stream trainer spans (step/eval/snapshot timings) to this JSONL file
+    # from process 0; feeds tools/trace_summary.py. None = ring buffer only.
+    spans_jsonl: Optional[str] = None
 
     @classmethod
     def make(cls, **kwargs: Any) -> "TrainerConfig":

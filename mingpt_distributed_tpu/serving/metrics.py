@@ -6,114 +6,266 @@ per-step gauges (queue depth, slot utilization), and per-request latency
 over log windows with the same ``RateWindow`` the training MetricsLogger
 uses, so the two subsystems report rates with identical semantics.
 
+ISSUE 5: every number here is now a typed instrument registered in a
+:class:`~..telemetry.MetricsRegistry` under ``mingpt_serve_*`` — no
+private accumulator dicts. TTFT / ITL / admission-stall / prefill-chunk
+latencies are fixed-ladder histograms (``LATENCY_BUCKETS_S``), request
+outcomes are one labeled counter family, and the padded-bucket fit is a
+``bucket``-labeled counter. The pre-existing attribute surface
+(``metrics.requests_completed``, ``metrics.bucket_histogram``, ...) is
+preserved as read-only views over the instruments, and ``summary()`` /
+``log_line()`` emit the same shapes as before.
+
 Output surfaces: a periodic one-line log (``log_every`` scheduler steps,
-process-stdout, same pipe-separated shape as the trainer's step line) and
+process-stdout, same pipe-separated shape as the trainer's step line),
 an on-demand JSON summary (``summary()`` / ``write_json()``) for offline
-batch runs and the serve.py ``--selftest`` gate.
+batch runs and the serve.py ``--selftest`` gate, and — when the process
+registry is injected — the shared Prometheus ``/metrics`` page.
 """
 
 from __future__ import annotations
 
 import json
-import time
 from typing import Any, Dict, Optional
 
-from mingpt_distributed_tpu.training.metrics import RateWindow
+from mingpt_distributed_tpu.telemetry import (
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+    RateWindow,
+)
 
 
 class ServingMetrics:
-    def __init__(self, n_slots: int, log_every: int = 0, enabled: bool = True):
+    def __init__(
+        self,
+        n_slots: int,
+        log_every: int = 0,
+        enabled: bool = True,
+        registry: Optional[MetricsRegistry] = None,
+    ):
         self.n_slots = max(n_slots, 1)
         self.log_every = log_every
         self.enabled = enabled
+        self.registry = registry if registry is not None else MetricsRegistry()
+        r = self.registry
         # counters
-        self.requests_submitted = 0
-        self.requests_completed = 0
-        self.requests_rejected = 0   # bounded-queue submit refusals
-        self.requests_expired = 0    # deadline hits (queued or mid-decode)
-        self.requests_failed = 0     # on_token callback raised
-        self.prefills = 0
-        self.tokens_generated = 0
-        self.steps = 0
+        self._requests = r.counter(
+            "mingpt_serve_requests_total",
+            help="requests by outcome (submitted counts admissions to the "
+                 "queue; rejected = bounded-queue refusals; expired = "
+                 "deadline hits; failed = on_token callback raised)",
+            labels=("outcome",),
+        )
+        self._prefills = r.counter(
+            "mingpt_serve_prefills_total", help="admissions fully prefilled")
+        self._tokens = r.counter(
+            "mingpt_serve_tokens_generated_total",
+            help="decode tokens emitted")
+        self._steps = r.counter(
+            "mingpt_serve_steps_total", help="scheduler rounds executed")
         # prefill accounting (ISSUE 3): real prompt tokens forwarded, the
-        # padded bucket histogram (how well the ladder fits the traffic),
-        # and wall time spent inside prefill calls — the decode-stall
-        # budget admissions consume
-        self.prefill_chunks = 0
-        self.prefill_tokens = 0          # real (unpadded) prompt tokens
-        self.prefill_padded_tokens = 0   # bucket lengths actually forwarded
-        self.bucket_histogram: Dict[int, int] = {}
-        self._prefill_time_s = 0.0
+        # padded bucket fit (how well the ladder matches the traffic), and
+        # wall time inside prefill calls — the decode-stall budget
+        # admissions consume
+        self._prefill_chunks = r.counter(
+            "mingpt_serve_prefill_chunks_total",
+            help="padded prefill calls issued")
+        self._prefill_tokens = r.counter(
+            "mingpt_serve_prefill_tokens_total",
+            help="real (unpadded) prompt tokens prefilled")
+        self._prefill_padded = r.counter(
+            "mingpt_serve_prefill_padded_tokens_total",
+            help="bucket lengths actually forwarded (incl. padding and "
+                 "shifted-final-chunk overlap)")
+        self._prefill_seconds = r.counter(
+            "mingpt_serve_prefill_seconds_total",
+            help="wall seconds spent inside prefill calls")
+        self._bucket_counter = r.counter(
+            "mingpt_serve_prefill_bucket_total",
+            help="prefill chunks by padded bucket length",
+            labels=("bucket",),
+        )
+        # shared-prefix store
+        self._prefix_lookups = r.counter(
+            "mingpt_serve_prefix_lookups_total",
+            help="prefix-cache lookups at admission")
+        self._prefix_hits = r.counter(
+            "mingpt_serve_prefix_hits_total", help="prefix-cache hits")
+        self._prefix_rows = r.counter(
+            "mingpt_serve_prefix_rows_reused_total",
+            help="KV rows restored from the prefix cache instead of "
+                 "recomputed")
+        # latency histograms (fixed ladder — comparable across scrapes)
+        self._ttft = r.histogram(
+            "mingpt_serve_ttft_seconds",
+            help="time to first token per admission",
+            buckets=LATENCY_BUCKETS_S,
+        )
+        self._itl = r.histogram(
+            "mingpt_serve_itl_seconds",
+            help="mean inter-token latency per completed request",
+            buckets=LATENCY_BUCKETS_S,
+        )
+        self._stall = r.histogram(
+            "mingpt_serve_admission_stall_seconds",
+            help="slot claim to first token — decode stall an admission "
+                 "costs its co-tenants",
+            buckets=LATENCY_BUCKETS_S,
+        )
+        self._chunk_hist = r.histogram(
+            "mingpt_serve_prefill_chunk_seconds",
+            help="wall time of one padded prefill call",
+            buckets=LATENCY_BUCKETS_S,
+        )
+        # gauges sampled at step boundaries
+        self._queue_depth = r.gauge(
+            "mingpt_serve_queue_depth", help="queued requests after the "
+            "last scheduler round")
+        self._slots_active = r.gauge(
+            "mingpt_serve_slots_active", help="occupied slots after the "
+            "last scheduler round")
+        self._util = r.gauge(
+            "mingpt_serve_slot_utilization",
+            help="mean fraction of decode lanes doing useful work")
+        self._tps = r.gauge(
+            "mingpt_serve_tokens_per_sec",
+            help="decode tokens/sec over the last log window")
+        self._prefill_tps = r.gauge(
+            "mingpt_serve_prefill_tokens_per_sec",
+            help="real prompt tokens/sec over the last prefill window")
+        self._hit_rate = r.gauge(
+            "mingpt_serve_prefix_hit_rate",
+            help="prefix-cache hits / lookups so far")
+        self._util_sum = 0.0
         self._prefill_rate = RateWindow()
         self._prefill_tokens_per_sec: Optional[float] = None
-        # shared-prefix store
-        self.prefix_lookups = 0
-        self.prefix_hits = 0
-        self.prefix_rows_reused = 0
-        # latency accumulators (seconds)
-        self._ttft_sum = 0.0
-        self._ttft_count = 0
-        self._stall_sum = 0.0            # per-admission slot-claim → first token
-        self._itl_sum = 0.0
-        self._itl_count = 0
-        # gauges sampled at step boundaries
-        self.queue_depth = 0
-        self.slots_active = 0
-        self._util_sum = 0.0
         self._rate = RateWindow()
         self._tokens_per_sec: Optional[float] = None
 
+    # -- back-compat attribute views over the instruments ---------------
+    @property
+    def requests_submitted(self) -> int:
+        return int(self._requests.labels(outcome="submitted").value)
+
+    @property
+    def requests_completed(self) -> int:
+        return int(self._requests.labels(outcome="completed").value)
+
+    @property
+    def requests_rejected(self) -> int:
+        return int(self._requests.labels(outcome="rejected").value)
+
+    @property
+    def requests_expired(self) -> int:
+        return int(self._requests.labels(outcome="expired").value)
+
+    @property
+    def requests_failed(self) -> int:
+        return int(self._requests.labels(outcome="failed").value)
+
+    @property
+    def prefills(self) -> int:
+        return int(self._prefills.value)
+
+    @property
+    def tokens_generated(self) -> int:
+        return int(self._tokens.value)
+
+    @property
+    def steps(self) -> int:
+        return int(self._steps.value)
+
+    @property
+    def prefill_chunks(self) -> int:
+        return int(self._prefill_chunks.value)
+
+    @property
+    def prefill_tokens(self) -> int:
+        return int(self._prefill_tokens.value)
+
+    @property
+    def prefill_padded_tokens(self) -> int:
+        return int(self._prefill_padded.value)
+
+    @property
+    def bucket_histogram(self) -> Dict[int, int]:
+        return {
+            int(labels["bucket"]): int(child.value)
+            for labels, child in self._bucket_counter.children()
+        }
+
+    @property
+    def prefix_lookups(self) -> int:
+        return int(self._prefix_lookups.value)
+
+    @property
+    def prefix_hits(self) -> int:
+        return int(self._prefix_hits.value)
+
+    @property
+    def prefix_rows_reused(self) -> int:
+        return int(self._prefix_rows.value)
+
+    @property
+    def queue_depth(self) -> int:
+        return int(self._queue_depth.value)
+
+    @property
+    def slots_active(self) -> int:
+        return int(self._slots_active.value)
+
     # -- event hooks (called by the scheduler) -------------------------
     def on_submit(self) -> None:
-        self.requests_submitted += 1
+        self._requests.labels(outcome="submitted").inc()
 
     def on_reject(self) -> None:
-        self.requests_rejected += 1
+        self._requests.labels(outcome="rejected").inc()
 
     def on_expire(self) -> None:
-        self.requests_expired += 1
+        self._requests.labels(outcome="expired").inc()
 
     def on_error(self) -> None:
-        self.requests_failed += 1
+        self._requests.labels(outcome="failed").inc()
 
     def on_prefill(self, ttft_s: float, stall_s: float = 0.0) -> None:
         """One admission finished prefilling. ``stall_s`` is the wall time
         from slot claim to first token — what this admission cost its
         co-tenants in decode stall."""
-        self.prefills += 1
-        self._ttft_sum += ttft_s
-        self._ttft_count += 1
-        self._stall_sum += stall_s
+        self._prefills.inc()
+        self._ttft.observe(ttft_s)
+        self._stall.observe(stall_s)
 
     def on_prefill_chunk(self, n_tokens: int, bucket: int, seconds: float) -> None:
         """One prefill call: ``n_tokens`` real prompt tokens forwarded as
         a ``bucket``-length padded chunk."""
-        self.prefill_chunks += 1
-        self.prefill_tokens += n_tokens
-        self.prefill_padded_tokens += bucket
-        self.bucket_histogram[bucket] = self.bucket_histogram.get(bucket, 0) + 1
-        self._prefill_time_s += seconds
+        self._prefill_chunks.inc()
+        self._prefill_tokens.inc(n_tokens)
+        self._prefill_padded.inc(bucket)
+        self._bucket_counter.labels(bucket=bucket).inc()
+        self._prefill_seconds.inc(seconds)
+        self._chunk_hist.observe(seconds)
         rate = self._prefill_rate.observe(self.prefill_tokens)
         if rate is not None:
             self._prefill_tokens_per_sec = rate
+            self._prefill_tps.set(rate)
 
     def on_prefix_lookup(self, hit: bool, rows: int, enabled: bool = True) -> None:
         if not enabled:
             return
-        self.prefix_lookups += 1
+        self._prefix_lookups.inc()
         if hit:
-            self.prefix_hits += 1
-            self.prefix_rows_reused += rows
+            self._prefix_hits.inc()
+            self._prefix_rows.inc(rows)
+        self._hit_rate.set(self.prefix_hits / self.prefix_lookups)
 
     def on_tokens(self, n: int) -> None:
-        self.tokens_generated += n
+        self._tokens.inc(n)
 
     def on_complete(self, n_generated: int, gen_span_s: float) -> None:
         """gen_span_s: first-token to last-token wall time."""
-        self.requests_completed += 1
+        self._requests.labels(outcome="completed").inc()
         if n_generated > 1:
-            self._itl_sum += gen_span_s / (n_generated - 1)
-            self._itl_count += 1
+            self._itl.observe(gen_span_s / (n_generated - 1))
 
     def on_step(
         self, queue_depth: int, slots_active: int, lanes_used: Optional[int] = None
@@ -121,29 +273,31 @@ class ServingMetrics:
         """queue_depth/slots_active: end-of-round gauges (occupancy after
         retirement). lanes_used: slots that actually decoded this step —
         what utilization of the shared decode batch means."""
-        self.steps += 1
-        self.queue_depth = queue_depth
-        self.slots_active = slots_active
+        self._steps.inc()
+        self._queue_depth.set(queue_depth)
+        self._slots_active.set(slots_active)
         used = slots_active if lanes_used is None else lanes_used
         self._util_sum += used / self.n_slots
+        self._util.set(self._util_sum / self.steps)
         rate = self._rate.observe(self.tokens_generated)
         if rate is not None:
             self._tokens_per_sec = rate
+            self._tps.set(rate)
         if self.enabled and self.log_every and self.steps % self.log_every == 0:
             print(self.log_line(), flush=True)
 
     # -- read-out ------------------------------------------------------
     @property
     def ttft_mean_s(self) -> Optional[float]:
-        return self._ttft_sum / self._ttft_count if self._ttft_count else None
+        return self._ttft.sum / self._ttft.count if self._ttft.count else None
 
     @property
     def itl_mean_s(self) -> Optional[float]:
-        return self._itl_sum / self._itl_count if self._itl_count else None
+        return self._itl.sum / self._itl.count if self._itl.count else None
 
     @property
     def admission_stall_mean_s(self) -> Optional[float]:
-        return self._stall_sum / self.prefills if self.prefills else None
+        return self._stall.sum / self.prefills if self.prefills else None
 
     @property
     def prefix_hit_rate(self) -> Optional[float]:
@@ -204,7 +358,7 @@ class ServingMetrics:
             "prefill_tokens": self.prefill_tokens,
             "prefill_padded_tokens": self.prefill_padded_tokens,
             "prefill_pad_overhead": self.prefill_pad_overhead,
-            "prefill_time_s": self._prefill_time_s,
+            "prefill_time_s": self._prefill_seconds.value,
             "prefill_tokens_per_sec": self._prefill_tokens_per_sec,
             "bucket_histogram": {
                 str(k): v for k, v in sorted(self.bucket_histogram.items())

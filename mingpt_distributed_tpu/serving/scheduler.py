@@ -70,6 +70,11 @@ import numpy as np
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
+from mingpt_distributed_tpu.telemetry import (
+    MetricsRegistry,
+    RecompileWatchdog,
+    SpanTracer,
+)
 
 
 class QueueFullError(RuntimeError):
@@ -157,6 +162,9 @@ class InferenceServer:
         prefill_chunk: Optional[int] = None,
         prefix_cache_mb: float = 0.0,
         warmup: bool = False,
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[SpanTracer] = None,
+        recompile_fail: bool = False,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(
@@ -164,7 +172,19 @@ class InferenceServer:
             prefill_buckets=prefill_buckets, prefill_chunk=prefill_chunk,
             prefix_cache_mb=prefix_cache_mb,
         )
-        self.metrics = metrics or ServingMetrics(n_slots, log_every=log_every)
+        self.metrics = metrics or ServingMetrics(
+            n_slots, log_every=log_every, registry=registry)
+        # disabled-by-default tracer: span() returns a shared no-op, so the
+        # scheduling loop pays nothing unless telemetry is wired in
+        self.tracer = tracer if tracer is not None else SpanTracer(enabled=False)
+        # post-warmup recompile watchdog over the engine's compiled program
+        # families (armed after warmup(); checked every scheduling round)
+        self.watchdog = RecompileWatchdog(
+            self.engine.compile_counts,
+            registry=self.metrics.registry if registry is None else registry,
+            tracer=self.tracer,
+            hard_fail=recompile_fail,
+        )
         self.on_token = on_token
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -192,6 +212,7 @@ class InferenceServer:
         self._req_keys: List[Optional[jax.Array]] = [None] * n_slots
         if warmup:
             self.engine.warmup()
+            self.watchdog.arm()
 
     # -- submission ----------------------------------------------------
     def submit(self, request: Request) -> RequestHandle:
@@ -376,39 +397,46 @@ class InferenceServer:
                 self._expire_if_due(h, now)
 
         while self.queue and self.engine.pool.free_count:
-            self._admit(self.queue.popleft())
+            h = self.queue.popleft()
+            with self.tracer.span("serve.admit", request_id=h.request_id):
+                self._admit(h)
 
         # one chunk per prefilling slot per round: a long prompt's
         # admission cost is spread out, so co-tenant inter-token latency
         # is bounded by one chunk forward, not one full-prompt forward
         for h in list(self._slots):
             if h is not None and h.prefilling:
-                self._prefill_one_chunk(h)
+                with self.tracer.span(
+                        "serve.prefill_chunk", request_id=h.request_id,
+                        pos=h.prefill_pos):
+                    self._prefill_one_chunk(h)
 
         active = [s for s, h in enumerate(self._slots)
                   if h is not None and not h.prefilling]
         if active:
-            for s in active:
-                handle = self._slots[s]
-                self._keys[s] = jax.random.fold_in(
-                    self._req_keys[s], len(handle.tokens))
-            nxt = self.engine.decode_step(
-                self._tokens, self._positions, self._temps, self._top_ks,
-                self._top_ps, self._do_sample, jnp.stack(self._keys),
-            )
-            for s in active:
-                handle = self._slots[s]
-                token = int(nxt[s])
-                ok = self._emit(handle, token)
-                self._tokens[s] = token
-                self._positions[s] += 1
-                if not ok:
-                    self._fail(handle, "error")
-                elif self._check_stop(handle, token):
-                    self._retire(handle)
+            with self.tracer.span("serve.decode_round", lanes=len(active)):
+                for s in active:
+                    handle = self._slots[s]
+                    self._keys[s] = jax.random.fold_in(
+                        self._req_keys[s], len(handle.tokens))
+                nxt = self.engine.decode_step(
+                    self._tokens, self._positions, self._temps, self._top_ks,
+                    self._top_ps, self._do_sample, jnp.stack(self._keys),
+                )
+                for s in active:
+                    handle = self._slots[s]
+                    token = int(nxt[s])
+                    ok = self._emit(handle, token)
+                    self._tokens[s] = token
+                    self._positions[s] += 1
+                    if not ok:
+                        self._fail(handle, "error")
+                    elif self._check_stop(handle, token):
+                        self._retire(handle)
 
         occupied = sum(h is not None for h in self._slots)
         self.metrics.on_step(len(self.queue), occupied, lanes_used=len(active))
+        self.watchdog.check()
         return bool(self.queue) or occupied > 0
 
     def run_until_drained(self, max_steps: Optional[int] = None) -> None:

@@ -1,0 +1,109 @@
+"""Unified telemetry subsystem (ISSUE 5): one registry, spans, exporters,
+recompile watchdog.
+
+The whole stack reports through this package:
+
+* ``registry``  — counters / gauges / fixed-ladder histograms in ONE
+  :class:`MetricsRegistry`; ``RateWindow`` (the shared windowed-rate
+  plumbing) lives here too.
+* ``peaks``     — the single roofline table (``PEAK_FLOPS`` /
+  ``PEAK_HBM_BYTES``) both ``training/metrics.py`` and ``bench.py``
+  consume.
+* ``spans``     — monotonic-clock nested spans in a bounded ring with an
+  optional JSONL sink, plus ``log_event`` (prefixed, attributable
+  replacement for bare prints in multi-process paths).
+* ``export``    — Prometheus text exposition + strict parser, the
+  versioned JSONL event schema, and the stdlib ``/metrics`` +
+  ``/healthz`` HTTP server.
+* ``watchdog``  — post-warmup recompile detection over the serving
+  engine's compiled program families.
+
+Process-wide defaults: :func:`get_registry` / :func:`get_tracer` are the
+lazily-created singletons entry points (``train.py``, ``serve.py``) wire
+into every logger so one scrape page exposes the whole process. Library
+classes (``MetricsLogger``, ``ServingMetrics``) default to private
+instances for test isolation — pass the globals explicitly to unify.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from mingpt_distributed_tpu.telemetry.export import (
+    SCHEMA_VERSION,
+    JsonlEventSink,
+    TelemetryServer,
+    parse_prometheus,
+    render_prometheus,
+)
+from mingpt_distributed_tpu.telemetry.peaks import (
+    PEAK_FLOPS,
+    PEAK_HBM_BYTES,
+    peak_flops_per_chip,
+    peak_hbm_bytes_per_chip,
+)
+from mingpt_distributed_tpu.telemetry.registry import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricFamily,
+    MetricsRegistry,
+    RateWindow,
+)
+from mingpt_distributed_tpu.telemetry.spans import (
+    SpanTracer,
+    log_event,
+    process_index,
+)
+from mingpt_distributed_tpu.telemetry.watchdog import (
+    RecompileError,
+    RecompileWatchdog,
+)
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "LATENCY_BUCKETS_S",
+    "PEAK_FLOPS",
+    "PEAK_HBM_BYTES",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "JsonlEventSink",
+    "MetricFamily",
+    "MetricsRegistry",
+    "RateWindow",
+    "RecompileError",
+    "RecompileWatchdog",
+    "SpanTracer",
+    "TelemetryServer",
+    "get_registry",
+    "get_tracer",
+    "log_event",
+    "parse_prometheus",
+    "peak_flops_per_chip",
+    "peak_hbm_bytes_per_chip",
+    "process_index",
+    "render_prometheus",
+]
+
+_registry: Optional[MetricsRegistry] = None
+_tracer: Optional[SpanTracer] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide registry every entry point exports from."""
+    global _registry
+    if _registry is None:
+        _registry = MetricsRegistry()
+    return _registry
+
+
+def get_tracer() -> SpanTracer:
+    """The process-wide tracer, gated to process 0 (single-writer, the
+    same convention as MetricsLogger) — other processes get a disabled
+    tracer whose spans are no-ops."""
+    global _tracer
+    if _tracer is None:
+        _tracer = SpanTracer(enabled=process_index() == 0)
+    return _tracer

@@ -1,0 +1,105 @@
+"""Recompile watchdog (ISSUE 5 tentpole, part 4).
+
+The serving engine's whole design is a *bounded compiled-program family*
+— after ``warmup()`` no request may ever trigger a new XLA trace
+(tests/test_serving.py asserts trace counts for specific scenarios).
+This module turns that one-off test idiom into an always-on runtime
+invariant: arm the watchdog on a snapshot of the engine's per-family
+trace counts, then ``check()`` at scheduling-round boundaries. Any
+post-warmup growth increments
+``mingpt_recompiles_total{family=...}``, emits a telemetry event, and —
+under the hard-fail knob (constructor arg, or ``MINGPT_RECOMPILE_FATAL=1``
+for tests/CI) — raises :class:`RecompileError` so the regression is a
+red build, not a silent latency cliff in production.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+from mingpt_distributed_tpu.telemetry.registry import MetricsRegistry
+from mingpt_distributed_tpu.telemetry.spans import SpanTracer, log_event
+
+__all__ = ["RecompileError", "RecompileWatchdog"]
+
+
+class RecompileError(RuntimeError):
+    """A compiled program family grew after the watchdog was armed."""
+
+
+class RecompileWatchdog:
+    """Counts tracer re-entries on compiled program families.
+
+    ``counts_fn`` returns ``{family_name: trace_count}`` — e.g.
+    ``DecodeEngine.compile_counts``. Until :meth:`arm` is called the
+    watchdog is dormant (pre-warmup compiles are expected and free to
+    happen); after arming, every :meth:`check` reports growth since the
+    previous baseline and advances the baseline, so each recompile is
+    counted exactly once.
+    """
+
+    def __init__(
+        self,
+        counts_fn: Callable[[], Dict[str, int]],
+        registry: Optional[MetricsRegistry] = None,
+        tracer: Optional[SpanTracer] = None,
+        hard_fail: bool = False,
+    ):
+        if registry is None:
+            from mingpt_distributed_tpu import telemetry
+
+            registry = telemetry.get_registry()
+        self.tracer = tracer
+        self.hard_fail = (
+            hard_fail or os.environ.get("MINGPT_RECOMPILE_FATAL") == "1"
+        )
+        self._counts_fn = counts_fn
+        self._baseline: Optional[Dict[str, int]] = None
+        self._counter = registry.counter(
+            "mingpt_recompiles_total",
+            help="post-warmup XLA traces of a compiled program family "
+                 "(should stay 0 for the process lifetime)",
+            labels=("family",),
+        )
+        self.recompiles = 0  # total counted by this watchdog instance
+
+    @property
+    def armed(self) -> bool:
+        return self._baseline is not None
+
+    def arm(self) -> None:
+        """Snapshot the current trace counts as the allowed baseline
+        (call after warmup, when the full family is pre-traced)."""
+        self._baseline = dict(self._counts_fn())
+
+    def check(self) -> int:
+        """Count new traces since the last check; 0 when unarmed."""
+        if self._baseline is None:
+            return 0
+        current = dict(self._counts_fn())
+        grown = {
+            fam: n - self._baseline.get(fam, 0)
+            for fam, n in current.items()
+            if n > self._baseline.get(fam, 0)
+        }
+        if not grown:
+            return 0
+        self._baseline = current  # count each trace exactly once
+        total = sum(grown.values())
+        self.recompiles += total
+        for fam, n in grown.items():
+            self._counter.labels(family=fam).inc(n)
+            if self.tracer is not None:
+                self.tracer.event("recompile", family=fam, new_traces=n)
+        detail = ", ".join(f"{fam}+{n}" for fam, n in sorted(grown.items()))
+        log_event(
+            f"recompile watchdog: {total} post-warmup compile(s) ({detail})",
+            tracer=self.tracer,
+        )
+        if self.hard_fail:
+            raise RecompileError(
+                f"post-warmup recompile detected: {detail} — the compiled "
+                f"program family must be bounded after warmup()"
+            )
+        return total

@@ -6,83 +6,32 @@ self-deprecates the approach (README.md:74). Here: structured per-step
 metrics — loss (already a global mean: the batch axis spans the whole mesh),
 grad norm, LR, tokens/sec/chip and MFU from the 6ND flop model — emitted from
 process 0 only, to stdout and optionally a JSONL file (pluggable sink).
+
+ISSUE 5: the roofline peak tables, the ``RateWindow`` helper, and the
+JSONL schema now live in ``mingpt_distributed_tpu.telemetry`` (re-exported
+here for back-compat), and every scalar the logger prints is also set on
+``mingpt_train_*`` gauges in a :class:`~..telemetry.MetricsRegistry` —
+pass the process registry (``telemetry.get_registry()``) to expose them
+on the same ``/metrics`` page as the serving metrics.
 """
 
 from __future__ import annotations
 
-import json
-import time
-from typing import Any, Dict, Optional, TextIO
-
-import jax
+import re
+from typing import Any, Dict, Optional
 
 from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.telemetry import (  # noqa: F401 — re-exports
+    PEAK_FLOPS,
+    PEAK_HBM_BYTES,
+    JsonlEventSink,
+    MetricsRegistry,
+    RateWindow,
+    peak_flops_per_chip,
+    peak_hbm_bytes_per_chip,
+)
 
-# Peak dense bf16 FLOP/s per chip, for MFU. Public numbers.
-PEAK_FLOPS: dict[str, float] = {
-    "TPU v4": 275e12,
-    "TPU v5 lite": 197e12,  # v5e
-    "TPU v5e": 197e12,
-    "TPU v5": 459e12,  # v5p
-    "TPU v5p": 459e12,
-    "TPU v6 lite": 918e12,  # v6e (Trillium)
-}
-
-
-# Peak HBM bandwidth per chip (bytes/s), for memory-bound rooflines
-# (KV-cached decode streams the whole parameter set per token, so its
-# ceiling is bandwidth, not FLOPs). Public numbers.
-PEAK_HBM_BYTES: dict[str, float] = {
-    "TPU v4": 1228e9,
-    "TPU v5 lite": 819e9,  # v5e
-    "TPU v5e": 819e9,
-    "TPU v5": 2765e9,  # v5p
-    "TPU v5p": 2765e9,
-    "TPU v6 lite": 1640e9,  # v6e (Trillium)
-}
-
-
-class RateWindow:
-    """Windowed rate of a monotonically increasing marker (steps, tokens).
-
-    ``observe(marker)`` returns the marker's change per second since the
-    previous call, or None on the first call / when the marker did not
-    advance. Shared plumbing between the training MetricsLogger (steps/sec
-    → tokens/sec/MFU) and the serving metrics (tokens/sec, serving/metrics
-    .py) so both report rates over the same kind of log window.
-    """
-
-    def __init__(self) -> None:
-        self._last: Optional[tuple[float, float]] = None
-
-    def observe(self, marker: float, now: Optional[float] = None) -> Optional[float]:
-        if now is None:
-            now = time.perf_counter()
-        rate = None
-        if self._last is not None:
-            last_t, last_m = self._last
-            if marker > last_m and now > last_t:
-                rate = (marker - last_m) / (now - last_t)
-        self._last = (now, marker)
-        return rate
-
-
-def _chip_lookup(table: dict[str, float]) -> Optional[float]:
-    # longest-prefix-wins by dict order: "TPU v5 lite" is listed before
-    # "TPU v5" in both tables, so v5e doesn't read the v5p row
-    kind = jax.devices()[0].device_kind
-    for name, val in table.items():
-        if kind.startswith(name):
-            return val
-    return None
-
-
-def peak_flops_per_chip() -> Optional[float]:
-    return _chip_lookup(PEAK_FLOPS)
-
-
-def peak_hbm_bytes_per_chip() -> Optional[float]:
-    return _chip_lookup(PEAK_HBM_BYTES)
+_GAUGE_SAFE_RE = re.compile(r"[^a-zA-Z0-9_]")
 
 
 def flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
@@ -100,9 +49,19 @@ def flops_per_token(cfg: GPTConfig, seq_len: Optional[int] = None) -> float:
 
 
 class MetricsLogger:
-    """stdout + optional JSONL + optional TensorBoard sinks; rate/MFU
-    computed over log windows (SURVEY §5.5's prescription — the reference
-    logs per-rank unreduced loss via print only, trainer.py:144-147)."""
+    """stdout + optional JSONL + optional TensorBoard sinks + registry
+    gauges; rate/MFU computed over log windows (SURVEY §5.5's prescription
+    — the reference logs per-rank unreduced loss via print only,
+    trainer.py:144-147).
+
+    ``registry`` defaults to a fresh private one (test isolation, the
+    prometheus_client idiom); entry points pass
+    ``telemetry.get_registry()`` so training gauges land on the shared
+    scrape page. The JSONL sink writes the versioned
+    ``mingpt-telemetry/1`` schema with ``kind: "train_step"`` and the
+    per-step scalars flat at the top level (pre-existing consumers that
+    read ``rec["loss"]``/``rec["step"]`` are unaffected).
+    """
 
     def __init__(
         self,
@@ -112,13 +71,15 @@ class MetricsLogger:
         tensorboard_dir: Optional[str] = None,
         n_chips: int = 1,
         enabled: bool = True,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.cfg = cfg
         self.n_chips = max(n_chips, 1)
         self.enabled = enabled
-        self._jsonl: Optional[TextIO] = None
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._jsonl: Optional[JsonlEventSink] = None
         if enabled and jsonl_path:
-            self._jsonl = open(jsonl_path, "a")
+            self._jsonl = JsonlEventSink(jsonl_path)
         self._tb = None
         if enabled and tensorboard_dir:
             try:
@@ -129,6 +90,27 @@ class MetricsLogger:
                 print(f"tensorboard sink unavailable ({e}); continuing")
         self._rate = RateWindow()
         self._peak = peak_flops_per_chip()
+        self._step_gauge = self.registry.gauge(
+            "mingpt_train_step", help="last logged training step")
+        self._gauges: Dict[str, Any] = {}
+        # Pre-register the headline families so the scrape page advertises
+        # them from process start — MFU in particular may never be observed
+        # on chips missing from the peak table (e.g. the CPU test mesh).
+        for key, help_ in (
+            ("loss", "training loss (global mean over the mesh batch axis)"),
+            ("mfu", "model FLOPs utilization vs the chip's roofline peak"),
+        ):
+            self._gauges[key] = self.registry.gauge(
+                f"mingpt_train_{key}", help=help_)
+
+    def _gauge(self, key: str):
+        g = self._gauges.get(key)
+        if g is None:
+            safe = _GAUGE_SAFE_RE.sub("_", key)
+            g = self.registry.gauge(
+                f"mingpt_train_{safe}", help=f"training scalar {key!r}")
+            self._gauges[key] = g
+        return g
 
     def log_step(
         self, step: int, tokens_per_step: int, seq_len: int, scalars: Dict[str, Any]
@@ -144,14 +126,17 @@ class MetricsLogger:
             rec["flops_per_chip"] = flops
             if self._peak:
                 rec["mfu"] = flops / self._peak
+        self._step_gauge.set(step)
+        for k, v in rec.items():
+            if k != "step":
+                self._gauge(k).set(v)
         if self.enabled:
             parts = [f"step {step}"] + [
                 f"{k} {v:.4g}" for k, v in rec.items() if k != "step"
             ]
             print(" | ".join(parts), flush=True)
             if self._jsonl:
-                self._jsonl.write(json.dumps(rec) + "\n")
-                self._jsonl.flush()
+                self._jsonl.write("train_step", dict(rec))
             if self._tb:
                 for k, v in rec.items():
                     if k != "step":

@@ -55,6 +55,7 @@ from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
 from mingpt_distributed_tpu.training.durability import RetryPolicy
 from mingpt_distributed_tpu.training.metrics import MetricsLogger
 from mingpt_distributed_tpu.training.optimizer import lr_schedule, make_optimizer
+from mingpt_distributed_tpu.telemetry import SpanTracer, TelemetryServer, log_event
 
 TrainState = Dict[str, Any]  # {"params", "opt_state", "step"}
 
@@ -199,6 +200,27 @@ class GPTTrainer:
         self.is_writer = self.process_index == 0  # B9 fix: GLOBAL process 0
         self.experiment_config = experiment_config
 
+        # --- telemetry (ISSUE 5): spans + optional /metrics endpoint ------
+        # Tracer enabled on the writer only (single-writer convention, same
+        # as MetricsLogger); spans cover step dispatch, eval and snapshots.
+        self.tracer = SpanTracer(enabled=self.is_writer)
+        if config.spans_jsonl and self.is_writer:
+            self.tracer.attach_jsonl(config.spans_jsonl)
+        self.telemetry_server: Optional[TelemetryServer] = None
+        metrics_registry = None
+        if config.metrics_port and self.is_writer:
+            from mingpt_distributed_tpu import telemetry
+
+            metrics_registry = telemetry.get_registry()
+            self.telemetry_server = TelemetryServer(
+                metrics_registry, port=config.metrics_port
+            )
+            log_event(
+                f"telemetry: serving /metrics and /healthz on "
+                f"{self.telemetry_server.url()}",
+                tracer=self.tracer,
+            )
+
         batch_ways = int(
             np.prod([self.mesh.shape[a] for a in mesh_lib.BATCH_AXES])
         )
@@ -299,7 +321,8 @@ class GPTTrainer:
             )
         if restored is None:
             if self.is_writer:
-                print("Snapshot not found. Training model from scratch")
+                log_event("Snapshot not found. Training model from scratch",
+                          tracer=self.tracer)
             self.state = jax.jit(init_fn, out_shardings=self.shardings)()
             self.start_epoch = 0
         else:
@@ -340,9 +363,11 @@ class GPTTrainer:
                     jnp.asarray(restored.prng)
                 )
             if self.is_writer:
-                print(
+                log_event(
                     f"Resuming training from snapshot at epoch "
-                    f"{restored.epoch}, step {restored.step}"
+                    f"{restored.epoch}, step {restored.step}",
+                    tracer=self.tracer,
+                    epoch=restored.epoch, step=restored.step,
                 )
 
         # --- compiled steps ----------------------------------------------
@@ -368,6 +393,7 @@ class GPTTrainer:
             ),
             n_chips=len(jax.devices()),
             enabled=self.is_writer,
+            registry=metrics_registry,
         )
         if self.is_writer:
             print(gpt.model_size_report(self.state["params"], gpt_config))
@@ -442,10 +468,11 @@ class GPTTrainer:
             raise KeyboardInterrupt
         name = signal.Signals(signum).name
         if self.is_writer:
-            print(
+            log_event(
                 f"[trainer] {name} received — stopping at the next step "
                 f"boundary, snapshotting, then exiting with code "
-                f"{REQUEUE_EXIT_CODE} (requeue)"
+                f"{REQUEUE_EXIT_CODE} (requeue)",
+                tracer=self.tracer, signal=name,
             )
         self.request_stop(signum)
 
@@ -505,10 +532,15 @@ class GPTTrainer:
                 source = PrefetchIterator(source, depth=cfg.prefetch)
             for xy in source:
                 batch = self._put_batch(xy)
-                self.state, m = self._train_step(self.state, batch, self.base_rng)
-                if prev_metrics is not None:
-                    jax.block_until_ready(prev_metrics)
-                prev_metrics = m
+                # the span measures host-visible step time: dispatch of step
+                # N plus the wait on step N-1 (the two-in-flight cap below)
+                with self.tracer.span("train.step", step=py_step + 1):
+                    self.state, m = self._train_step(
+                        self.state, batch, self.base_rng
+                    )
+                    if prev_metrics is not None:
+                        jax.block_until_ready(prev_metrics)
+                    prev_metrics = m
                 py_step = step = py_step + 1
                 consumed += 1
                 # jax.profiler trace window (SURVEY §5.1: the reference has
@@ -523,7 +555,10 @@ class GPTTrainer:
                         jax.block_until_ready(m)
                         jax.profiler.stop_trace()
                         self._tracing = False
-                        print(f"profiler trace written to {cfg.profile_dir}")
+                        log_event(
+                            f"profiler trace written to {cfg.profile_dir}",
+                            tracer=self.tracer, step=step,
+                        )
                 if step % cfg.log_every == 0 or (
                     cfg.max_steps and step >= cfg.max_steps
                 ):
@@ -596,13 +631,14 @@ class GPTTrainer:
         assert self.test_iter is not None
         losses = []
         self.test_iter.state = IteratorState(seed=self.config.seed)
-        for i, xy in enumerate(self.test_iter.epoch_batches()):
-            if self.config.eval_batches and i >= self.config.eval_batches:
-                break
-            losses.append(self._eval_step(self.state, self._put_batch(xy)))
-            if len(losses) >= 2:
-                jax.block_until_ready(losses[-2])
-        return float(np.mean([float(v) for v in jax.device_get(losses)]))
+        with self.tracer.span("train.eval"):
+            for i, xy in enumerate(self.test_iter.epoch_batches()):
+                if self.config.eval_batches and i >= self.config.eval_batches:
+                    break
+                losses.append(self._eval_step(self.state, self._put_batch(xy)))
+                if len(losses) >= 2:
+                    jax.block_until_ready(losses[-2])
+            return float(np.mean([float(v) for v in jax.device_get(losses)]))
 
     def save_snapshot(self, epoch: int) -> None:
         """Single-writer (global process 0 — the B9 fix) snapshot.
@@ -612,6 +648,10 @@ class GPTTrainer:
         the state is first gathered to every host with a collective
         (process_allgather); only process 0 then writes.
         """
+        with self.tracer.span("train.snapshot", epoch=epoch):
+            self._save_snapshot(epoch)
+
+    def _save_snapshot(self, epoch: int) -> None:
         common = dict(
             step=self.step,
             epoch=epoch,
@@ -691,9 +731,10 @@ class GPTTrainer:
                         ckpt_lib.save_snapshot(
                             path, host_snap, keep=keep, retry=retry
                         )
-                        print(
+                        log_event(
                             f"Snapshot saved to {path} "
-                            f"(epoch {epoch}, step {step}, msgpack, async)"
+                            f"(epoch {epoch}, step {step}, msgpack, async)",
+                            tracer=self.tracer, epoch=epoch, step=step,
                         )
                     except BaseException as e:  # re-raised at next join
                         self._save_exc = e
@@ -711,7 +752,17 @@ class GPTTrainer:
                     retry=self._retry,
                 )
         if self.is_writer:
-            print(
+            log_event(
                 f"Snapshot saved to {self.snapshot_path} "
-                f"(epoch {epoch}, step {self.step}, {self.ckpt_backend})"
+                f"(epoch {epoch}, step {self.step}, {self.ckpt_backend})",
+                tracer=self.tracer, epoch=epoch, step=self.step,
             )
+
+    def close(self) -> None:
+        """Release telemetry resources: metric sinks, the span JSONL, and
+        the /metrics endpoint (idempotent)."""
+        self.metrics.close()
+        self.tracer.close()
+        if self.telemetry_server is not None:
+            self.telemetry_server.close()
+            self.telemetry_server = None

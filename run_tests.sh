@@ -43,16 +43,21 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest
 
-# Prefill-overhaul gate (ISSUE 3): the same parity selftest with a
-# multi-bucket ladder, chunked prefill (6-token chunks force several
-# chunks per prompt) and the shared-prefix store enabled — exercises
-# bucketed + chunked admission and a prefix-cache hit end-to-end, still
-# demanding token-identical greedy output and a bounded program family.
+# Prefill-overhaul gate (ISSUE 3) + telemetry smoke (ISSUE 5): the same
+# parity selftest with a multi-bucket ladder, chunked prefill (6-token
+# chunks force several chunks per prompt) and the shared-prefix store
+# enabled — exercises bucketed + chunked admission and a prefix-cache hit
+# end-to-end, still demanding token-identical greedy output and a bounded
+# program family. --metrics-port 0 additionally stands up the Prometheus
+# endpoint on an ephemeral port; the selftest self-scrapes /metrics,
+# validates the exposition with the strict parser, and asserts the
+# recompile watchdog counted zero post-warmup traces.
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest --prefill-chunk 6 \
-        --prefill-buckets 4,6,8,16,32,48 --prefix-cache-mb 4 --warmup
+        --prefill-buckets 4,6,8,16,32,48 --prefix-cache-mb 4 --warmup \
+        --metrics-port 0
 
 # Durability gate: fault-injected checkpoint save/restore roundtrip on a
 # tmpdir — every 3rd write fails transiently (retries must absorb it) and

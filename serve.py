@@ -28,6 +28,12 @@ Common knobs: --slots N, --max-new-tokens, --temperature, --top-k,
 --metrics-json PATH, --log-every N, plus section.key=value config
 overrides as in train.py/sample.py.
 
+Telemetry (ISSUE 5): --metrics-port P exposes Prometheus /metrics and
+/healthz from the process-wide telemetry registry (0 = ephemeral port,
+printed to stderr); the selftest additionally self-scrapes the page,
+validates it with the strict exposition parser, and asserts the
+recompile watchdog counted zero post-warmup traces.
+
 Robustness knobs (ISSUE 2): --queue-limit N bounds the request queue
 (over-limit submissions are rejected with a clean error instead of
 growing without bound); --deadline-s S expires requests that exceed
@@ -99,6 +105,10 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="pre-trace the prefill bucket ladder and decode "
                         "step before serving (no first-request compile "
                         "stall)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus /metrics + /healthz on this port "
+                        "(0 = ephemeral port, printed at start); default: "
+                        "no endpoint")
     p.add_argument("overrides", nargs="*")
     return p
 
@@ -121,6 +131,22 @@ def _server_kwargs(args) -> dict:
         prefix_cache_mb=args.prefix_cache_mb,
         warmup=args.warmup,
     )
+
+
+def _start_telemetry(args):
+    """(registry, TelemetryServer | None) for this process. With
+    --metrics-port the process-wide registry is exposed on /metrics (0
+    binds an ephemeral port, printed so callers/CI can scrape it);
+    without it the registry still unifies the in-process metrics."""
+    from mingpt_distributed_tpu import telemetry
+
+    reg = telemetry.get_registry()
+    if args.metrics_port is None:
+        return reg, None
+    tserver = telemetry.TelemetryServer(reg, port=args.metrics_port)
+    print(f"[serve] telemetry: /metrics and /healthz on {tserver.url('')}",
+          file=sys.stderr)
+    return reg, tserver
 
 
 def _request_for(args, tokens, eos_id=None):
@@ -156,6 +182,7 @@ def selftest(args) -> int:
     from mingpt_distributed_tpu.models import generate as gen
     from mingpt_distributed_tpu.models import gpt
     from mingpt_distributed_tpu.serving import InferenceServer, Request
+    from mingpt_distributed_tpu.training.metrics import MetricsLogger
 
     cfg = GPTConfig.make(
         n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
@@ -170,8 +197,14 @@ def selftest(args) -> int:
         prompts += [[ord(c) % cfg.vocab_size for c in s] for s in canned[-2:]]
     max_new = 12
 
+    # one registry for the whole page: serving instruments + the trainer
+    # gauge families (a silent MetricsLogger registers mingpt_train_*, so
+    # the scrape asserts the unified exposition, not just serving's half)
+    reg, tserver = _start_telemetry(args)
+    MetricsLogger(cfg, enabled=False, registry=reg)
     server = InferenceServer(params, cfg, n_slots=2,
                              log_every=args.log_every,
+                             registry=reg,
                              **_server_kwargs(args))
     handles = server.generate_batch(
         [Request(prompt=p, max_new_tokens=max_new) for p in prompts])
@@ -195,6 +228,20 @@ def selftest(args) -> int:
     if args.prefix_cache_mb > 0 and server.metrics.prefix_hits < 1:
         print("selftest FAIL: prefix store enabled but no hit recorded")
         rc = 1
+    # recompile watchdog: armed by --warmup; any post-warmup trace is a
+    # bounded-program-family regression
+    wd = server.watchdog
+    if args.warmup and not wd.armed:
+        print("selftest FAIL: --warmup set but watchdog not armed")
+        rc = 1
+    if wd.recompiles:
+        print(f"selftest FAIL: watchdog counted {wd.recompiles} "
+              f"post-warmup recompile(s)")
+        rc = 1
+    print(f"selftest watchdog: armed={wd.armed} recompiles={wd.recompiles}")
+    if tserver is not None:
+        rc |= _selftest_scrape(tserver)
+        tserver.close()
     summary = server.summary()
     print("selftest metrics:", json.dumps(summary))
     if args.metrics_json:
@@ -203,6 +250,53 @@ def selftest(args) -> int:
         print("selftest FAIL: not all requests completed")
         rc = 1
     print("selftest", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
+def _selftest_scrape(tserver) -> int:
+    """Scrape our own /metrics over HTTP and validate it with the strict
+    exposition parser (grammar + histogram-triplet coherence — not
+    string-contains): the unified page must carry serving latency
+    histograms, utilization/prefix gauges, the trainer gauge families and
+    a zero recompile count."""
+    import urllib.request
+
+    from mingpt_distributed_tpu.telemetry import parse_prometheus
+
+    rc = 0
+    with urllib.request.urlopen(tserver.url("/healthz"), timeout=10) as resp:
+        health = json.loads(resp.read().decode())
+    if health.get("status") != "ok":
+        print(f"selftest FAIL: /healthz says {health}")
+        rc = 1
+    with urllib.request.urlopen(tserver.url("/metrics"), timeout=10) as resp:
+        text = resp.read().decode()
+    try:
+        parsed = parse_prometheus(text)
+    except ValueError as e:
+        print(f"selftest FAIL: /metrics is not valid exposition text: {e}")
+        return 1
+    required = {
+        "mingpt_serve_ttft_seconds": "histogram",
+        "mingpt_serve_itl_seconds": "histogram",
+        "mingpt_serve_slot_utilization": "gauge",
+        "mingpt_serve_prefix_hit_rate": "gauge",
+        "mingpt_train_loss": "gauge",
+        "mingpt_train_mfu": "gauge",
+        "mingpt_recompiles_total": "counter",
+    }
+    for name, kind in required.items():
+        got = parsed["types"].get(name)
+        if got != kind:
+            print(f"selftest FAIL: /metrics lacks {kind} {name} (got {got})")
+            rc = 1
+    recompiles = sum(v for n, _labels, v in parsed["samples"]
+                     if n == "mingpt_recompiles_total")
+    if recompiles:
+        print(f"selftest FAIL: /metrics reports {recompiles} recompile(s)")
+        rc = 1
+    n = len(parsed["samples"])
+    print(f"selftest scrape: {n} samples, recompiles_total {recompiles:g}")
     return rc
 
 
@@ -254,6 +348,7 @@ def main(argv=None) -> int:
         printed[handle.request_id] = text
         sys.stdout.flush()
 
+    reg, tserver = _start_telemetry(args)
     if args.prompts_file:
         with open(args.prompts_file) as f:
             lines = [ln.rstrip("\n") for ln in f if ln.strip()]
@@ -261,6 +356,7 @@ def main(argv=None) -> int:
                                  log_every=args.log_every,
                                  max_queue=args.queue_limit,
                                  default_deadline_s=args.deadline_s,
+                                 registry=reg,
                                  **_server_kwargs(args))
         # per-request isolation: one bad prompt (encode failure, validation
         # error, queue rejection) is reported and skipped — the batch keeps
@@ -282,6 +378,8 @@ def main(argv=None) -> int:
         print(json.dumps(server.summary()))
         if args.metrics_json:
             server.metrics.write_json(args.metrics_json)
+        if tserver is not None:
+            tserver.close()
         return 0
 
     # REPL: one prompt per stdin line, streamed as it decodes
@@ -289,6 +387,7 @@ def main(argv=None) -> int:
                              on_token=on_token, log_every=0,
                              max_queue=args.queue_limit,
                              default_deadline_s=args.deadline_s,
+                             registry=reg,
                              **_server_kwargs(args))
     interactive = sys.stdin.isatty()
     if interactive:
@@ -314,6 +413,8 @@ def main(argv=None) -> int:
             print("prompt> ", end="", flush=True)
     if args.metrics_json:
         server.metrics.write_json(args.metrics_json)
+    if tserver is not None:
+        tserver.close()
     return 0
 
 

@@ -355,6 +355,48 @@ def test_bucket_ladder_trace_count_bounded_with_warmup(cfg_params):
     assert server.compile_counts() == counts
 
 
+def test_recompile_watchdog_quiet_after_warmup(cfg_params):
+    """ISSUE 5 acceptance: with warmup the watchdog arms at construction
+    and serving a mixed-length batch registers ZERO recompiles — the
+    machine-checked version of the compile_counts equality above."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=2,
+                             prefill_buckets=(4, 8, 16, 32), warmup=True)
+    assert server.watchdog.armed
+    n_for = {id(p): min(4, cfg.block_size - len(p)) for p in MIXED_PROMPTS}
+    handles = server.generate_batch(
+        [Request(prompt=p, max_new_tokens=n_for[id(p)])
+         for p in MIXED_PROMPTS])
+    assert all(h.finished for h in handles)
+    assert server.watchdog.recompiles == 0
+
+
+def test_recompile_watchdog_counts_cold_traces(cfg_params):
+    """Armed BEFORE any trace exists (no warmup), the first request's
+    prefill+decode compilations surface as recompiles, labeled by
+    program family in the shared registry counter."""
+    from mingpt_distributed_tpu.telemetry import SpanTracer
+
+    cfg, params = cfg_params
+    tracer = SpanTracer()
+    server = InferenceServer(params, cfg, n_slots=2, tracer=tracer)
+    assert not server.watchdog.armed
+    server.watchdog.arm()
+    server.submit(Request(prompt=PROMPTS[0], max_new_tokens=4))
+    server.run_until_drained(max_steps=50)
+    # cold start traced prefill once and decode once, each counted once
+    assert server.watchdog.recompiles == 2
+    fam = server.metrics.registry.counter(
+        "mingpt_recompiles_total", labels=("family",))
+    by_family = {labels["family"]: child.value
+                 for labels, child in fam.children() if child.value}
+    assert by_family == {"prefill": 1.0, "decode": 1.0}
+    # the firing is mirrored into the span tracer as point events
+    fired = {r["family"] for r in tracer.records()
+             if r.get("kind") == "event" and r.get("name") == "recompile"}
+    assert fired == {"prefill", "decode"}
+
+
 def test_chunked_prefill_staggered_admission_parity(cfg_params):
     """A long prompt admitted mid-decode prefills in chunks across
     scheduler rounds while the co-tenant keeps decoding — the decode

@@ -122,6 +122,43 @@ def test_busy_union_chained_extension():
     assert "busy 0.07 ms" in "\n".join(out)
 
 
+def test_span_jsonl_input(tmp_path, capsys):
+    """A telemetry span JSONL (ISSUE 5) is an alternate input: spans
+    become X events laned by subsystem prefix and run through the same
+    aggregation as profiler traces."""
+    from mingpt_distributed_tpu.telemetry import SpanTracer
+
+    p = tmp_path / "spans.jsonl"
+    tr = SpanTracer()
+    tr.attach_jsonl(str(p))
+    with tr.span("train.step", step=1):
+        with tr.span("train.snapshot"):
+            pass
+    with tr.span("serve.decode_round", lanes=2):
+        pass
+    tr.event("recompile", family="decode")  # no duration: must be skipped
+    tr.close()
+
+    trace = trace_summary.load_span_jsonl(str(p))
+    assert all(e["ph"] == "X" for e in trace["traceEvents"])
+    assert {e["tid"] for e in trace["traceEvents"]} == {"train", "serve"}
+    assert len(trace["traceEvents"]) == 3  # the point event is dropped
+
+    rc = trace_summary.main([str(p), "--top", "5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "trace span:" in out
+    assert "train.step" in out and "serve.decode_round" in out
+
+
+def test_span_jsonl_without_spans_errors(tmp_path, capsys):
+    p = tmp_path / "empty.jsonl"
+    p.write_text('{"schema": "mingpt-telemetry/1", "kind": "event"}\n')
+    rc = trace_summary.main([str(p)])
+    assert rc == 1
+    assert "no span records" in capsys.readouterr().err
+
+
 def test_multihost_pid_namespacing(tmp_path):
     """Two hosts' trace files must keep separate lanes (pid collision)."""
     run = tmp_path / "plugins" / "profile" / "run1"

@@ -145,7 +145,7 @@ def main(argv=None) -> int:
     try:
         trainer.train()
     finally:
-        trainer.metrics.close()
+        trainer.close()  # metric sinks, span JSONL, /metrics endpoint
         distributed.shutdown()  # destroy_process_group analogue
     if trainer.preempted:
         # stopped on SIGTERM/SIGINT with a committed snapshot: tell the
