@@ -24,28 +24,56 @@ what a server needs on top of it:
   utilization, per-request TTFT and inter-token latency; periodic log line
   plus a JSON summary, sharing the RateWindow plumbing of
   training/metrics.py.
+* ``Router`` / ``ReplicaSupervisor`` (fleet.py) — the resilient
+  multi-replica layer: supervised in-process replicas with health-gated
+  prefix-affinity routing, per-replica circuit breakers, bounded
+  idempotent retry, deadline-aware load shedding and graceful drain;
+  request state (requests.py) split from slot state so a request can
+  outlive the replica serving it.
 
-Everything is CPU-testable with a tiny config (tests/test_serving.py) and
-driven end-to-end by ``serve.py`` at the repo root.
+Everything is CPU-testable with a tiny config (tests/test_serving.py,
+tests/test_fleet.py) and driven end-to-end by ``serve.py`` at the repo
+root.
 """
 
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
+from mingpt_distributed_tpu.serving.fleet import (
+    CircuitBreaker,
+    FleetHandle,
+    Replica,
+    ReplicaSupervisor,
+    Router,
+    VirtualClock,
+    WallClock,
+    default_server_factory,
+)
 from mingpt_distributed_tpu.serving.kv_pool import PrefixKVStore, SlotKVPool
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
-from mingpt_distributed_tpu.serving.scheduler import (
-    InferenceServer,
+from mingpt_distributed_tpu.serving.requests import (
     QueueFullError,
     Request,
     RequestHandle,
+    ShedError,
 )
+from mingpt_distributed_tpu.serving.scheduler import InferenceServer, SlotTable
 
 __all__ = [
+    "CircuitBreaker",
     "DecodeEngine",
+    "FleetHandle",
     "InferenceServer",
     "PrefixKVStore",
     "QueueFullError",
+    "Replica",
+    "ReplicaSupervisor",
     "Request",
     "RequestHandle",
+    "Router",
     "ServingMetrics",
+    "ShedError",
     "SlotKVPool",
+    "SlotTable",
+    "VirtualClock",
+    "WallClock",
+    "default_server_factory",
 ]

@@ -61,6 +61,22 @@ class ServingMetrics:
         self._tokens = r.counter(
             "mingpt_serve_tokens_generated_total",
             help="decode tokens emitted")
+        # fleet-facing rejection family (ISSUE 6): every refused admission
+        # lands here with WHY it was refused — queue_full (bounded queue),
+        # shed (global depth watermark), breaker_open (no replica's
+        # circuit breaker admits traffic), deadline (cannot be met),
+        # draining (graceful shutdown). The legacy outcome="rejected"
+        # counter keeps aggregating them all.
+        self._rejected = r.counter(
+            "mingpt_serving_rejected_total",
+            help="refused admissions by reason (queue_full | shed | "
+                 "breaker_open | deadline | draining)",
+            labels=("reason",),
+        )
+        for _reason in ("queue_full", "shed", "breaker_open",
+                        "deadline", "draining"):
+            # pre-touch so every reason is scrape-visible at zero
+            self._rejected.labels(reason=_reason).inc(0)
         self._steps = r.counter(
             "mingpt_serve_steps_total", help="scheduler rounds executed")
         # prefill accounting (ISSUE 3): real prompt tokens forwarded, the
@@ -218,8 +234,9 @@ class ServingMetrics:
     def on_submit(self) -> None:
         self._requests.labels(outcome="submitted").inc()
 
-    def on_reject(self) -> None:
+    def on_reject(self, reason: str = "queue_full") -> None:
         self._requests.labels(outcome="rejected").inc()
+        self._rejected.labels(reason=reason).inc()
 
     def on_expire(self) -> None:
         self._requests.labels(outcome="expired").inc()
@@ -294,6 +311,23 @@ class ServingMetrics:
     @property
     def itl_mean_s(self) -> Optional[float]:
         return self._itl.sum / self._itl.count if self._itl.count else None
+
+    @property
+    def ttft_p99_s(self) -> Optional[float]:
+        """Ladder-resolution p99 (upper bound) — the health-gate signal."""
+        return self._ttft.quantile(0.99)
+
+    @property
+    def itl_p99_s(self) -> Optional[float]:
+        """Ladder-resolution p99 (upper bound) — the health-gate signal."""
+        return self._itl.quantile(0.99)
+
+    @property
+    def rejected_by_reason(self) -> Dict[str, int]:
+        return {
+            labels["reason"]: int(child.value)
+            for labels, child in self._rejected.children()
+        }
 
     @property
     def admission_stall_mean_s(self) -> Optional[float]:

@@ -32,19 +32,31 @@ token-identical to solo ``generate()`` on the same prompt under every
 combination of bucketing, chunking and prefix reuse (asserted in
 tests/test_serving.py): chunked prefill is row-equivalent to the
 one-shot forward, and prefix rows are bit-identical to what recomputing
-them would produce.
+them would produce. The same property is what makes fleet-level retry
+idempotent (serving/fleet.py): a crashed replica's request re-prefills
+from the original prompt on a survivor and produces the same greedy
+token at every index, so already-streamed tokens dedup by position.
 
 Prompt bounds: prompts longer than ``prefill_len`` are cropped to their
 last ``prefill_len`` tokens (the server has no sliding-window decode path
 — unlike solo ``generate()``'s overflow semantics, positions restart at 0
 for the cropped prompt), and ``max_new_tokens`` is clamped so decode
-positions never leave the ``block_size`` window.
+positions never leave the ``block_size`` window. ``strict_window=True``
+rejects instead of cropping/clamping (``Request.validate`` with the
+engine's bounds).
+
+Request state vs slot state (ISSUE 6 split): :class:`Request`,
+:class:`RequestHandle` and the backpressure errors live in
+``serving/requests.py`` — a request outlives the replica serving it.
+:class:`SlotTable` below owns everything that dies with this engine:
+the handle↔slot binding and the per-slot decode-state arrays.
 
 Robustness under sustained traffic (ISSUE 2):
 
 * **bounded queue** — ``max_queue`` caps waiting requests; beyond it,
-  ``submit`` raises :class:`QueueFullError` (backpressure the caller can
-  act on) instead of growing the deque without bound;
+  ``submit`` raises :class:`QueueFullError` carrying the observed depth
+  and a suggested retry-after (backpressure the caller can act on)
+  instead of growing the deque without bound;
 * **deadlines** — a per-request ``deadline_s`` (or the server-wide
   ``default_deadline_s``) expires requests at step boundaries, whether
   still queued, mid-prefill or mid-decode, so an abandoned request can
@@ -60,7 +72,6 @@ from __future__ import annotations
 import itertools
 import time
 from collections import deque
-from dataclasses import dataclass, field
 from typing import Any, Callable, Deque, Dict, List, Optional, Sequence
 
 import jax
@@ -70,6 +81,12 @@ import numpy as np
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
+from mingpt_distributed_tpu.serving.requests import (  # noqa: F401  (re-export)
+    QueueFullError,
+    Request,
+    RequestHandle,
+    ShedError,
+)
 from mingpt_distributed_tpu.telemetry import (
     MetricsRegistry,
     RecompileWatchdog,
@@ -77,70 +94,69 @@ from mingpt_distributed_tpu.telemetry import (
 )
 
 
-class QueueFullError(RuntimeError):
-    """submit() refused: the bounded request queue is at max depth.
-    Callers should shed load or retry later — backpressure, not OOM."""
+class SlotTable:
+    """Slot-side state of one engine replica: the handle occupying each
+    KV lane plus the per-slot decode-state arrays fed whole to the shared
+    compiled decode step.
 
+    Non-decoding lanes (free or still prefilling) are PARKED at position
+    ``block_size - 1``: the decode program writes one row per slot
+    unconditionally, and that row is the only one a later legitimate
+    writer is guaranteed to refill before any query can attend it —
+    parking anywhere lower could clobber rows a chunked prefill has
+    already written.
+    """
 
-@dataclass
-class Request:
-    """One generation request with its own sampling + stop parameters
-    (the per-request analogue of generate()'s keyword surface)."""
+    def __init__(self, n_slots: int, block_size: int):
+        self.n_slots = n_slots
+        self.parked = block_size - 1
+        self.handles: List[Optional[RequestHandle]] = [None] * n_slots
+        self.tokens = np.zeros(n_slots, np.int32)
+        self.positions = np.full(n_slots, self.parked, np.int32)
+        self.temps = np.ones(n_slots, np.float32)
+        self.top_ks = np.zeros(n_slots, np.int32)
+        self.top_ps = np.ones(n_slots, np.float32)
+        self.do_sample = np.zeros(n_slots, bool)
+        self.keys: List[jax.Array] = [jax.random.key(0)] * n_slots
+        self.req_keys: List[Optional[jax.Array]] = [None] * n_slots
 
-    prompt: Sequence[int]
-    max_new_tokens: int = 16
-    temperature: float = 1.0
-    top_k: Optional[int] = None
-    top_p: Optional[float] = None
-    do_sample: bool = False
-    eos_id: Optional[int] = None   # stop when this token is produced
-    seed: int = 0                  # per-request sampling PRNG seed
-    deadline_s: Optional[float] = None  # expire this long after submit
-    request_id: Optional[str] = None
+    def bind(self, slot: int, handle: RequestHandle, seed: int) -> None:
+        handle.slot = slot
+        self.handles[slot] = handle
+        self.req_keys[slot] = jax.random.key(seed)
 
-    def validate(self) -> None:
-        if len(self.prompt) < 1:
-            raise ValueError("empty prompt")
-        if self.max_new_tokens < 1:
-            raise ValueError(
-                f"max_new_tokens must be >= 1, got {self.max_new_tokens}")
-        if self.temperature < 0:
-            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
-        if self.deadline_s is not None and self.deadline_s < 0:
-            raise ValueError(
-                f"deadline_s must be >= 0, got {self.deadline_s}")
+    def release(self, slot: int) -> None:
+        self.handles[slot] = None
+        self.req_keys[slot] = None
+        self.positions[slot] = self.parked
 
+    def start_decode(self, slot: int, token: int, position: int,
+                     req: Request) -> None:
+        """Flip a freshly-prefilled slot to decoding: the first generated
+        token is fed at ``position`` (= len(prompt)) next round."""
+        self.tokens[slot] = token
+        self.positions[slot] = position
+        self.temps[slot] = req.temperature
+        self.top_ks[slot] = 0 if req.top_k is None else req.top_k
+        self.top_ps[slot] = 1.0 if req.top_p is None else req.top_p
+        self.do_sample[slot] = req.do_sample
 
-@dataclass
-class RequestHandle:
-    """Live view of a submitted request: ``tokens`` grows as the request
-    decodes; ``finished``/``finish_reason`` flip on retirement."""
+    def fold_key(self, slot: int, token_index: int) -> None:
+        self.keys[slot] = jax.random.fold_in(self.req_keys[slot], token_index)
 
-    request: Request
-    request_id: str
-    prompt_used: List[int]        # after cropping to prefill_len
-    max_new_effective: int        # after clamping to the block_size window
-    tokens: List[int] = field(default_factory=list)
-    finished: bool = False
-    finish_reason: Optional[str] = None  # "length" | "eos" | "deadline" | "error"
-    slot: Optional[int] = None
-    submit_time: float = 0.0
-    deadline: Optional[float] = None     # absolute clock time; None = never
-    error: Optional[BaseException] = None  # a raising on_token callback
-    first_token_time: Optional[float] = None
-    last_token_time: Optional[float] = None
-    # admission progress: cache rows [0, prefill_pos) of the slot hold
-    # this request's prompt (prefix-hit rows + completed chunks)
-    prefilling: bool = False
-    prefill_pos: int = 0
-    prefix_rows: int = 0          # rows served from the shared-prefix store
-    admit_time: Optional[float] = None
+    def stacked_keys(self) -> jax.Array:
+        return jnp.stack(self.keys)
+
+    def live_handles(self) -> List[RequestHandle]:
+        return [h for h in self.handles if h is not None]
+
+    def decoding_slots(self) -> List[int]:
+        return [s for s, h in enumerate(self.handles)
+                if h is not None and not h.prefilling]
 
     @property
-    def ttft_s(self) -> Optional[float]:
-        if self.first_token_time is None:
-            return None
-        return self.first_token_time - self.submit_time
+    def occupied(self) -> int:
+        return sum(h is not None for h in self.handles)
 
 
 class InferenceServer:
@@ -165,6 +181,8 @@ class InferenceServer:
         registry: Optional[MetricsRegistry] = None,
         tracer: Optional[SpanTracer] = None,
         recompile_fail: bool = False,
+        strict_window: bool = False,
+        fault_hook: Optional[Callable[[str], None]] = None,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(
@@ -191,38 +209,42 @@ class InferenceServer:
         self.max_queue = max_queue
         self.default_deadline_s = default_deadline_s
         self.clock = clock  # injectable for deterministic deadline tests
+        self.strict_window = strict_window
+        # chaos-harness hook (serving/fleet.py): called with a fault-point
+        # name at scheduling-loop boundaries; an injector raising here
+        # models a replica failing mid-round. "decode_round" fires after
+        # the compiled step returned but BEFORE any token is emitted —
+        # the computed tokens are lost, never streamed, so retry-on-a-
+        # survivor cannot double-emit.
+        self.fault_hook = fault_hook
         self.queue: Deque[RequestHandle] = deque()
-        self._slots: List[Optional[RequestHandle]] = [None] * n_slots
+        self.slots = SlotTable(n_slots, cfg.block_size)
         self._ids = itertools.count()
-        # per-slot decode-state arrays (host side, fed to the engine whole).
-        # Non-decoding lanes (free or still prefilling) are PARKED at
-        # position block_size-1: the shared decode program writes one row
-        # per slot unconditionally, and that row is the only one a later
-        # legitimate writer is guaranteed to refill before any query can
-        # attend it — parking anywhere lower could clobber rows a chunked
-        # prefill has already written.
-        self._parked = cfg.block_size - 1
-        self._tokens = np.zeros(n_slots, np.int32)
-        self._positions = np.full(n_slots, self._parked, np.int32)
-        self._temps = np.ones(n_slots, np.float32)
-        self._top_ks = np.zeros(n_slots, np.int32)
-        self._top_ps = np.ones(n_slots, np.float32)
-        self._do_sample = np.zeros(n_slots, bool)
-        self._keys: List[jax.Array] = [jax.random.key(0)] * n_slots
-        self._req_keys: List[Optional[jax.Array]] = [None] * n_slots
         if warmup:
             self.engine.warmup()
             self.watchdog.arm()
 
     # -- submission ----------------------------------------------------
     def submit(self, request: Request) -> RequestHandle:
-        request.validate()
+        if self.strict_window:
+            request.validate(block_size=self.cfg.block_size,
+                             prefill_len=self.engine.prefill_len)
+        else:
+            request.validate()
         if self.max_queue is not None and len(self.queue) >= self.max_queue:
-            self.metrics.on_reject()
+            depth = len(self.queue)
+            self.metrics.on_reject(reason="queue_full")
+            # suggested retry-after: roughly how long the queue takes to
+            # move one slot's worth of work — depth × observed ITL, with
+            # a floor so a cold server still suggests a sane backoff
+            itl = self.metrics.itl_mean_s
+            retry_after = max(0.05, depth * (itl if itl else 0.02))
             raise QueueFullError(
-                f"request queue full ({len(self.queue)}/{self.max_queue} "
-                f"waiting, {self.engine.pool.used_count} decoding) — shed "
-                f"load or retry later"
+                f"request queue full ({depth}/{self.max_queue} waiting, "
+                f"{self.engine.pool.used_count} decoding) — shed load or "
+                f"retry in ~{retry_after:.2f}s",
+                queue_depth=depth,
+                retry_after_s=retry_after,
             )
         pl = self.engine.prefill_len
         prompt = list(request.prompt)[-pl:]
@@ -284,9 +306,7 @@ class InferenceServer:
         if slot is not None:
             handle.slot = None
             handle.prefilling = False
-            self._slots[slot] = None
-            self._req_keys[slot] = None
-            self._positions[slot] = self._parked
+            self.slots.release(slot)
             self.engine.pool.free(slot)
 
     def _retire(self, handle: RequestHandle) -> None:
@@ -321,12 +341,9 @@ class InferenceServer:
         for long ones."""
         slot = self.engine.pool.allocate()
         assert slot is not None
-        req = handle.request
-        handle.slot = slot
         handle.prefilling = True
         handle.admit_time = self.clock()
-        self._slots[slot] = handle
-        self._req_keys[slot] = jax.random.key(req.seed)
+        self.slots.bind(slot, handle, handle.request.seed)
         hit = self.engine.try_load_prefix(slot, handle.prompt_used)
         self.metrics.on_prefix_lookup(
             hit > 0, hit, enabled=self.engine.prefix_store is not None)
@@ -357,7 +374,7 @@ class InferenceServer:
         tok, padded = self.engine.prefill_chunk_call(
             slot, prompt[off:end], off,
             req.temperature, req.top_k, req.top_p, req.do_sample,
-            jax.random.fold_in(self._req_keys[slot], 0),
+            jax.random.fold_in(self.slots.req_keys[slot], 0),
         )
         self.metrics.on_prefill_chunk(end - pos, padded, self.clock() - t0)
         handle.prefill_pos = end
@@ -370,17 +387,15 @@ class InferenceServer:
         now = self.clock()
         self.metrics.on_prefill(
             handle.ttft_s or 0.0, now - (handle.admit_time or now))
-        # slot decode state: the first token is fed at position len(prompt)
-        self._tokens[slot] = tok
-        self._positions[slot] = n_total
-        self._temps[slot] = req.temperature
-        self._top_ks[slot] = 0 if req.top_k is None else req.top_k
-        self._top_ps[slot] = 1.0 if req.top_p is None else req.top_p
-        self._do_sample[slot] = req.do_sample
+        self.slots.start_decode(slot, tok, n_total, req)
         if not ok:
             self._fail(handle, "error")
         elif self._check_stop(handle, tok):
             self._retire(handle)
+
+    def _fire_fault(self, where: str) -> None:
+        if self.fault_hook is not None:
+            self.fault_hook(where)
 
     def step(self) -> bool:
         """One scheduling round (expire → admit → prefill chunks → decode
@@ -392,9 +407,8 @@ class InferenceServer:
                           if self._expire_if_due(h, now)]
         if expired_queued:
             self.queue = deque(h for h in self.queue if not h.finished)
-        for h in list(self._slots):
-            if h is not None:
-                self._expire_if_due(h, now)
+        for h in self.slots.live_handles():
+            self._expire_if_due(h, now)
 
         while self.queue and self.engine.pool.free_count:
             h = self.queue.popleft()
@@ -404,40 +418,50 @@ class InferenceServer:
         # one chunk per prefilling slot per round: a long prompt's
         # admission cost is spread out, so co-tenant inter-token latency
         # is bounded by one chunk forward, not one full-prompt forward
-        for h in list(self._slots):
-            if h is not None and h.prefilling:
+        for h in self.slots.live_handles():
+            if h.prefilling:
                 with self.tracer.span(
                         "serve.prefill_chunk", request_id=h.request_id,
                         pos=h.prefill_pos):
                     self._prefill_one_chunk(h)
 
-        active = [s for s, h in enumerate(self._slots)
-                  if h is not None and not h.prefilling]
+        active = self.slots.decoding_slots()
         if active:
             with self.tracer.span("serve.decode_round", lanes=len(active)):
                 for s in active:
-                    handle = self._slots[s]
-                    self._keys[s] = jax.random.fold_in(
-                        self._req_keys[s], len(handle.tokens))
+                    self.slots.fold_key(s, len(self.slots.handles[s].tokens))
+                st = self.slots
                 nxt = self.engine.decode_step(
-                    self._tokens, self._positions, self._temps, self._top_ks,
-                    self._top_ps, self._do_sample, jnp.stack(self._keys),
+                    st.tokens, st.positions, st.temps, st.top_ks,
+                    st.top_ps, st.do_sample, st.stacked_keys(),
                 )
+                # chaos fault point: a raise here loses this round's
+                # computed tokens before any of them is emitted — the
+                # crash-mid-decode case the fleet retry must survive
+                # without double-emission
+                self._fire_fault("decode_round")
                 for s in active:
-                    handle = self._slots[s]
+                    handle = st.handles[s]
                     token = int(nxt[s])
                     ok = self._emit(handle, token)
-                    self._tokens[s] = token
-                    self._positions[s] += 1
+                    st.tokens[s] = token
+                    st.positions[s] += 1
                     if not ok:
                         self._fail(handle, "error")
                     elif self._check_stop(handle, token):
                         self._retire(handle)
 
-        occupied = sum(h is not None for h in self._slots)
+        occupied = self.slots.occupied
         self.metrics.on_step(len(self.queue), occupied, lanes_used=len(active))
         self.watchdog.check()
         return bool(self.queue) or occupied > 0
+
+    def unfinished(self) -> List[RequestHandle]:
+        """Every accepted-but-unfinished request — queued, prefilling or
+        decoding — in FIFO-ish order (queue first). The fleet router uses
+        this to re-admit a crashed replica's requests on survivors."""
+        live = [h for h in self.slots.live_handles() if not h.finished]
+        return list(self.queue) + live
 
     def run_until_drained(self, max_steps: Optional[int] = None) -> None:
         steps = 0

@@ -160,6 +160,26 @@ class Histogram:
             out.append((float("inf"), self.count))
         return out
 
+    def quantile(self, q: float) -> Optional[float]:
+        """Ladder-resolution quantile estimate: the smallest bucket upper
+        bound at which the cumulative count reaches ``q * count`` — a
+        conservative (upper-bound) estimate, which is the right bias for
+        SLO gating: a replica is flagged slow no later than its true
+        quantile crossing the threshold. None with no observations;
+        ``inf`` when the quantile falls in the overflow bucket."""
+        if not 0.0 < q <= 1.0:
+            raise ValueError(f"quantile must be in (0, 1], got {q}")
+        with self._lock:
+            if not self.count:
+                return None
+            need = q * self.count
+            acc = 0
+            for u, c in zip(self.uppers, self.counts):
+                acc += c
+                if acc >= need:
+                    return u
+        return float("inf")
+
 
 _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
 
@@ -241,6 +261,9 @@ class MetricFamily:
 
     def cumulative(self) -> List[Tuple[float, int]]:
         return self._default.cumulative()
+
+    def quantile(self, q: float) -> Optional[float]:
+        return self._default.quantile(q)
 
     @property
     def value(self) -> float:

@@ -28,6 +28,19 @@ Spec grammar (semicolon-separated): ``op[:field=value]...`` with fields
 ``nth`` (1-based one-shot), ``every`` (periodic), ``mode``
 (``error`` | ``truncate`` | ``delay`` | ``missing``), ``match``
 (substring filter on the path), ``delay`` (seconds, for mode=delay).
+
+Serving fault points (ISSUE 6): the same spec grammar and deterministic
+counters drive :class:`ServingFaultInjector`, whose ops sabotage the
+fleet's scheduling loop instead of the filesystem — ``crash`` (replica
+dies mid-decode, in-flight requests must retry on survivors), ``poison``
+(one scheduling round raises after the compiled step, before emission),
+``slow`` (virtual clock skew — NEVER a wall-clock sleep, so chaos tests
+stay fast and deterministic), ``admit`` (submission raises). ``match``
+filters on the replica name (``match=replica0``); the env knob is
+``MINGPT_SERVING_FAULTS``::
+
+    MINGPT_SERVING_FAULTS="crash:nth=6:match=replica0;slow:every=1:delay=0.25:match=replica1" \\
+        python serve.py --replicas 3 ...
 """
 
 from __future__ import annotations
@@ -44,6 +57,13 @@ from fsspec import AbstractFileSystem
 
 ENV_VAR = "MINGPT_FAULTS"
 ENV_TARGET = "MINGPT_FAULT_TARGET"
+SERVING_ENV_VAR = "MINGPT_SERVING_FAULTS"
+
+#: Filesystem fault points (the original grammar) vs serving fault points
+#: (fleet chaos harness). One FaultSpec grammar covers both; which set an
+#: injector accepts is validated at construction.
+IO_OPS = ("write", "read")
+SERVING_OPS = ("crash", "poison", "slow", "admit")
 
 
 @dataclass
@@ -61,8 +81,14 @@ class FaultSpec:
     count: int = field(default=0, compare=False)
 
     def __post_init__(self):
-        if self.op not in ("write", "read"):
-            raise ValueError(f"fault op must be write|read, got {self.op!r}")
+        if self.op not in IO_OPS + SERVING_OPS:
+            raise ValueError(
+                f"fault op must be one of {IO_OPS + SERVING_OPS}, "
+                f"got {self.op!r}")
+        if self.op == "slow" and self.mode == "error":
+            # "slow" only makes sense as a delay; default the mode so
+            # specs read naturally ("slow:every=1:delay=0.25")
+            self.mode = "delay"
         if self.mode not in ("error", "truncate", "delay", "missing"):
             raise ValueError(f"unknown fault mode {self.mode!r}")
         if not self.nth and not self.every:
@@ -238,6 +264,100 @@ class FaultInjectionFileSystem(AbstractFileSystem):
 
     def mkdir(self, path, create_parents=True, **kwargs):
         return self.target.mkdir(path, create_parents=create_parents, **kwargs)
+
+
+# ---------------------------------------------------------------------
+# Serving chaos harness (ISSUE 6)
+# ---------------------------------------------------------------------
+
+class InjectedServingFault(RuntimeError):
+    """Base of every fault the serving injector raises — the fleet layer
+    treats these exactly like organic replica failures (that's the
+    point), but tests can assert on the type."""
+
+
+class ReplicaCrashed(InjectedServingFault):
+    """The replica process 'died' mid-round: its engine/server object
+    must never be reused (host-side state may be mid-update); the
+    supervisor replaces it with a fresh server."""
+
+
+class InjectedAdmissionError(InjectedServingFault):
+    """submit() failed on this replica — routing should retry the
+    request elsewhere, not fail it."""
+
+
+class ServingFaultInjector:
+    """Deterministic fault schedule over the fleet's serving fault points,
+    sharing :class:`FaultSpec`'s grammar and counters with the I/O
+    injector. ``match`` filters on the replica name.
+
+    Fault points (where the fleet calls in):
+
+    * ``step_delay(replica)`` — before a replica's scheduling round.
+      Returns the virtual seconds of injected slowness (``slow`` specs;
+      the replica's *clock* is skewed — no wall sleep ever happens) and
+      raises :class:`ReplicaCrashed` for a due ``crash`` spec.
+    * ``round_hook(replica)`` — an ``InferenceServer.fault_hook``: a due
+      ``poison`` spec raises :class:`InjectedServingFault` mid-round,
+      after the compiled decode step but before any token is emitted.
+    * ``check_admit(replica)`` — inside replica submit; a due ``admit``
+      spec raises :class:`InjectedAdmissionError`.
+
+    Counters advance once per fault-point visit per matching spec, so a
+    given (spec, request schedule) pair produces the same chaos every
+    run — chaos tests are seeds, not dice.
+    """
+
+    def __init__(self, faults: Optional[str] = None):
+        text = faults if faults is not None else os.environ.get(
+            SERVING_ENV_VAR, "")
+        self.specs = parse_faults(text)
+        for s in self.specs:
+            if s.op not in SERVING_OPS:
+                raise ValueError(
+                    f"serving fault op must be one of {SERVING_OPS}, "
+                    f"got {s.op!r} (I/O ops belong in {ENV_VAR})")
+        self.fired: List[str] = []  # "(op, replica)" audit trail
+
+    def _fire(self, op: str, replica: str) -> Optional[FaultSpec]:
+        for s in self.specs:
+            if s.fires(op, replica):
+                self.fired.append(f"{op}:{replica}")
+                return s
+        return None
+
+    def reset_counters(self) -> None:
+        for s in self.specs:
+            s.count = 0
+        self.fired = []
+
+    # -- fault points ---------------------------------------------------
+    def step_delay(self, replica: str) -> float:
+        """Crash/slow verdict for one scheduling round of ``replica``.
+        Raises ReplicaCrashed or returns injected VIRTUAL delay seconds
+        (0.0 when healthy). The caller adds the delay to the replica's
+        clock skew; nothing here ever sleeps."""
+        if self._fire("crash", replica) is not None:
+            raise ReplicaCrashed(
+                f"injected crash: replica {replica} died mid-round")
+        spec = self._fire("slow", replica)
+        return spec.delay_s if spec is not None else 0.0
+
+    def round_hook(self, replica: str):
+        """An ``InferenceServer.fault_hook`` poisoning this replica's
+        scheduling round at the named fault point."""
+        def hook(where: str) -> None:
+            if self._fire("poison", replica) is not None:
+                raise InjectedServingFault(
+                    f"injected poison: replica {replica} raised at "
+                    f"{where}")
+        return hook
+
+    def check_admit(self, replica: str) -> None:
+        if self._fire("admit", replica) is not None:
+            raise InjectedAdmissionError(
+                f"injected admission failure on replica {replica}")
 
 
 def register() -> None:

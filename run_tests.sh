@@ -65,3 +65,16 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # digest-verified checkpoint, never load the torn one).
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python train.py --selftest-faults
+
+# Serving chaos gate (ISSUE 6): a 3-replica in-process fleet on a virtual
+# clock with injected faults — replica0 crashes mid-decode (its in-flight
+# requests retry on survivors), replica1 runs with injected clock skew
+# (health-gated on ITL p99 without a single wall sleep). Asserts greedy
+# token-identical output vs solo generate() for every request, zero
+# duplicate tokens in the caller-visible stream, breaker/retry/restart
+# counters visible in a strict-parsed /metrics scrape, and drain-time
+# shedding. Exits non-zero on any violation.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-chaos

@@ -49,6 +49,21 @@ steps, bounding co-tenant inter-token latency by one chunk;
 --prefix-cache-mb M keeps an LRU of shared-prefix KV rows so a request
 repeating a cached prompt head (system prompts) copies rows instead of
 recomputing them; --warmup pre-traces the whole ladder at start.
+
+Fleet knobs (ISSUE 6): --replicas N serves through N supervised
+in-process engine replicas behind the health/affinity Router
+(serving/fleet.py) — crashed replicas restart with backoff, their
+requests retry idempotently on survivors; --shed-watermark D sheds new
+requests once the fleet-wide queue depth reaches D; --chaos-spec (or
+MINGPT_SERVING_FAULTS) injects deterministic serving faults
+(crash/poison/slow/admit, same grammar as training/faults.py). Graceful
+shutdown everywhere: SIGTERM (or one SIGINT) stops admission, drains
+in-flight requests, flushes metrics and exits 75 (EX_TEMPFAIL, the
+trainer's requeue convention; a second SIGINT aborts hard). The
+--selftest-chaos gate (run_tests.sh) runs canned prompts through 3
+replicas under an injected crash-mid-decode + slow replica and asserts
+greedy parity with solo generate(), zero duplicate streamed tokens and
+the breaker/retry/shed counters on a strict-parsed /metrics scrape.
 """
 
 from __future__ import annotations
@@ -109,8 +124,51 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="serve Prometheus /metrics + /healthz on this port "
                         "(0 = ephemeral port, printed at start); default: "
                         "no endpoint")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="serve through N supervised in-process engine "
+                        "replicas behind the health/affinity router "
+                        "(default 1: single server, no fleet layer)")
+    p.add_argument("--shed-watermark", type=int, default=None,
+                   help="fleet mode: shed new requests once the fleet-wide "
+                        "queue depth reaches this watermark")
+    p.add_argument("--chaos-spec", default=None,
+                   help="deterministic serving fault spec, e.g. "
+                        "'crash:nth=6:match=replica0;slow:every=1:"
+                        "delay=0.25:match=replica1' (default: "
+                        "MINGPT_SERVING_FAULTS env; ops crash|poison|"
+                        "slow|admit)")
+    p.add_argument("--selftest-chaos", action="store_true",
+                   help="random-init tiny model through 3 replicas under "
+                        "injected crash + slow faults; verifies greedy "
+                        "parity, zero duplicate tokens and fleet metrics, "
+                        "then exits")
     p.add_argument("overrides", nargs="*")
     return p
+
+
+class _ShutdownGuard:
+    """SIGTERM/SIGINT → stop admission, drain, flush, exit 75 — the same
+    contract as trainer.py's preemption path. The first signal only sets
+    the flag (the serving loop finishes in-flight work); a second SIGINT
+    raises KeyboardInterrupt for a hard abort."""
+
+    def __init__(self):
+        self.stop_requested = False
+
+    def install(self) -> "_ShutdownGuard":
+        import signal
+
+        def handler(signum, frame):
+            if self.stop_requested and signum == signal.SIGINT:
+                raise KeyboardInterrupt
+            self.stop_requested = True
+            print(f"[serve] caught signal {signum}: admission stopped, "
+                  f"draining in-flight requests (SIGINT again to abort)",
+                  file=sys.stderr, flush=True)
+
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+        return self
 
 
 def _parse_buckets(spec):
@@ -300,8 +358,189 @@ def _selftest_scrape(tserver) -> int:
     return rc
 
 
+def selftest_chaos(args) -> int:
+    """The ISSUE 6 acceptance gate, CPU-only and fully deterministic
+    (virtual clock, seeded injector, zero wall sleeps): canned prompts
+    through 3 supervised replicas while the injector crashes replica0
+    mid-decode and makes replica1 slow. Every request must finish on a
+    surviving replica with greedy output token-identical to solo
+    generate(), the caller-visible stream must contain zero duplicate
+    tokens, and the breaker/retry/shed/crash counters must appear on a
+    strict-parsed /metrics scrape."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import generate as gen
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import (
+        ReplicaSupervisor,
+        Request,
+        Router,
+        ShedError,
+        VirtualClock,
+        default_server_factory,
+    )
+    from mingpt_distributed_tpu.training.faults import ServingFaultInjector
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's",
+              "Now is the winter", "Friends, Romans", "To be, or not"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 12
+    spec = args.chaos_spec or (
+        "crash:nth=6:match=replica0;slow:every=1:delay=0.25:match=replica1")
+    n_replicas = args.replicas if args.replicas > 1 else 3
+
+    if args.metrics_port is None:
+        args.metrics_port = 0  # the scrape assertions are part of the gate
+    reg, tserver = _start_telemetry(args)
+    injector = ServingFaultInjector(spec)
+    supervisor = ReplicaSupervisor(
+        default_server_factory(params, cfg, n_slots=2, **_server_kwargs(args)),
+        n_replicas=n_replicas,
+        clock=VirtualClock(tick_s=0.001),
+        injector=injector,
+        registry=reg,
+        max_restarts=1,
+        restart_backoff_s=0.01,
+        itl_slo_s=0.1,
+    )
+    streamed = {}
+
+    def on_token(fh, tok):
+        streamed.setdefault(fh.request_id, []).append(tok)
+
+    router = Router(
+        supervisor, on_token=on_token, max_retries=3, retry_backoff_s=0.01,
+        breaker_reset_s=0.05, shed_watermark=args.shed_watermark)
+    handles = [router.submit(Request(prompt=p, max_new_tokens=max_new))
+               for p in prompts]
+    router.run_until_drained(max_steps=5000)
+    summary = router.summary()
+    print("selftest-chaos fleet:", json.dumps(summary))
+
+    rc = 0
+    for text, p, h in zip(canned, prompts, handles):
+        want = np.asarray(
+            gen.generate(params, cfg, jnp.asarray(p, jnp.int32)[None],
+                         max_new))[0, len(p):].tolist()
+        ok = h.finish_reason == "length" and h.tokens == want
+        seen = streamed.get(h.request_id, [])
+        if seen != h.tokens:
+            print(f"selftest-chaos FAIL {h.request_id}: streamed {seen} != "
+                  f"handle {h.tokens} (duplicate or lost emission)")
+            rc = 1
+        print(f"selftest-chaos {h.request_id} ({text!r}): "
+              f"attempts={h.attempts} replica={h.replica} "
+              f"dups_suppressed={h.duplicates_suppressed} "
+              + ("OK" if ok else
+                 f"MISMATCH reason={h.finish_reason} "
+                 f"server={h.tokens} solo={want}"))
+        if not ok:
+            rc = 1
+
+    reps = summary["replicas"]
+    checks = [
+        ("replica0 crashed at least once",
+         reps["replica0"]["crashes"] >= 1),
+        ("crashed replica was restarted",
+         summary["requests_by_outcome"]["completed"] == len(canned)
+         and reps["replica0"]["state"] == "ready"),
+        ("crash retries were counted",
+         summary["retries_by_reason"]["crash"] >= 1),
+        ("re-emitted tokens were suppressed, not double-streamed",
+         summary["duplicates_suppressed"] >= 1),
+        ("slow replica accumulated injected clock skew",
+         reps["replica1"]["clock_skew_s"] > 0),
+        ("slow replica is health-gated on ITL p99",
+         "itl_p99" in reps["replica1"]["health_reasons"]),
+    ]
+    for what, ok in checks:
+        if not ok:
+            print(f"selftest-chaos FAIL: {what}")
+            rc = 1
+
+    # drain semantics: admission stops with a typed, counted rejection
+    router.drain()
+    try:
+        router.submit(Request(prompt=prompts[0], max_new_tokens=2))
+        print("selftest-chaos FAIL: draining fleet accepted a request")
+        rc = 1
+    except ShedError as e:
+        if e.reason != "draining":
+            print(f"selftest-chaos FAIL: drain shed reason {e.reason!r}")
+            rc = 1
+    if router.summary()["rejected_by_reason"]["draining"] < 1:
+        print("selftest-chaos FAIL: draining rejection not counted")
+        rc = 1
+
+    if tserver is not None:
+        rc |= _chaos_scrape(tserver)
+        tserver.close()
+    if args.metrics_json:
+        with open(args.metrics_json, "w") as f:
+            json.dump(router.summary(), f, indent=2)
+            f.write("\n")
+    print("selftest-chaos", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
+def _chaos_scrape(tserver) -> int:
+    """Strict-parse our own /metrics and assert the fleet resilience
+    families are present — breaker state, retries, crashes, restarts,
+    per-reason rejections, duplicate-token suppression."""
+    import urllib.request
+
+    from mingpt_distributed_tpu.telemetry import parse_prometheus
+
+    with urllib.request.urlopen(tserver.url("/metrics"), timeout=10) as resp:
+        text = resp.read().decode()
+    try:
+        parsed = parse_prometheus(text)
+    except ValueError as e:
+        print(f"selftest-chaos FAIL: /metrics is not valid exposition "
+              f"text: {e}")
+        return 1
+    rc = 0
+    required = {
+        "mingpt_serving_rejected_total": "counter",
+        "mingpt_fleet_retries_total": "counter",
+        "mingpt_fleet_crashes_total": "counter",
+        "mingpt_fleet_restarts_total": "counter",
+        "mingpt_fleet_breaker_state": "gauge",
+        "mingpt_fleet_replica_up": "gauge",
+        "mingpt_fleet_replica_healthy": "gauge",
+        "mingpt_fleet_duplicate_tokens_suppressed_total": "counter",
+    }
+    for name, kind in required.items():
+        got = parsed["types"].get(name)
+        if got != kind:
+            print(f"selftest-chaos FAIL: /metrics lacks {kind} {name} "
+                  f"(got {got})")
+            rc = 1
+    crashes = sum(v for n, _l, v in parsed["samples"]
+                  if n == "mingpt_fleet_crashes_total")
+    retries = sum(v for n, _l, v in parsed["samples"]
+                  if n == "mingpt_fleet_retries_total")
+    if crashes < 1 or retries < 1:
+        print(f"selftest-chaos FAIL: scrape shows crashes={crashes:g} "
+              f"retries={retries:g} (expected >= 1 each)")
+        rc = 1
+    print(f"selftest-chaos scrape: {len(parsed['samples'])} samples, "
+          f"crashes_total {crashes:g}, retries_total {retries:g}")
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    if args.selftest_chaos:
+        return selftest_chaos(args)
     if args.selftest:
         return selftest(args)
 
@@ -348,21 +587,83 @@ def main(argv=None) -> int:
         printed[handle.request_id] = text
         sys.stdout.flush()
 
+    guard = _ShutdownGuard().install()
     reg, tserver = _start_telemetry(args)
+
+    def build_backend(stream_cb):
+        """One InferenceServer by default; --replicas N puts the fleet
+        router in front of N supervised replicas. Both expose submit /
+        run_until_drained / summary with the same handle surface."""
+        if args.replicas > 1:
+            from mingpt_distributed_tpu.serving import (
+                ReplicaSupervisor,
+                Router,
+                WallClock,
+                default_server_factory,
+            )
+            from mingpt_distributed_tpu.training.faults import (
+                ServingFaultInjector,
+            )
+            injector = ServingFaultInjector(args.chaos_spec)
+            supervisor = ReplicaSupervisor(
+                default_server_factory(
+                    params, gpt_cfg, n_slots=args.slots,
+                    max_queue=args.queue_limit,
+                    default_deadline_s=args.deadline_s,
+                    **_server_kwargs(args)),
+                n_replicas=args.replicas,
+                clock=WallClock(),
+                injector=injector if injector.specs else None,
+                registry=reg,
+            )
+            return Router(supervisor, on_token=stream_cb,
+                          shed_watermark=args.shed_watermark)
+        return InferenceServer(params, gpt_cfg, n_slots=args.slots,
+                               on_token=stream_cb,
+                               log_every=(0 if stream_cb else args.log_every),
+                               max_queue=args.queue_limit,
+                               default_deadline_s=args.deadline_s,
+                               registry=reg,
+                               **_server_kwargs(args))
+
+    def shutdown(backend) -> int:
+        """Common exit path: drain in-flight work, flush metrics, close
+        the telemetry endpoint; exit 75 after a signal so schedulers
+        requeue instead of failing the job."""
+        if guard.stop_requested and hasattr(backend, "drain"):
+            backend.drain()
+        backend.run_until_drained()
+        if args.metrics_json:
+            if hasattr(backend, "metrics"):
+                backend.metrics.write_json(args.metrics_json)
+            else:
+                with open(args.metrics_json, "w") as f:
+                    json.dump(backend.summary(), f, indent=2)
+                    f.write("\n")
+        if tserver is not None:
+            tserver.close()
+        if guard.stop_requested:
+            from mingpt_distributed_tpu.serving.fleet import REQUEUE_EXIT_CODE
+
+            print(f"[serve] drained after signal; exiting "
+                  f"{REQUEUE_EXIT_CODE} (requeue)", file=sys.stderr)
+            return REQUEUE_EXIT_CODE
+        return 0
+
     if args.prompts_file:
         with open(args.prompts_file) as f:
             lines = [ln.rstrip("\n") for ln in f if ln.strip()]
-        server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
-                                 log_every=args.log_every,
-                                 max_queue=args.queue_limit,
-                                 default_deadline_s=args.deadline_s,
-                                 registry=reg,
-                                 **_server_kwargs(args))
+        server = build_backend(None)
         # per-request isolation: one bad prompt (encode failure, validation
         # error, queue rejection) is reported and skipped — the batch keeps
         # draining instead of the whole engine tearing down
         handles = []
         for ln in lines:
+            if guard.stop_requested:
+                print(f"[serve] admission stopped by signal; "
+                      f"{len(lines) - len(handles)} prompt(s) not admitted",
+                      file=sys.stderr)
+                break
             try:
                 handles.append(
                     (ln, server.submit(_request_for(
@@ -371,51 +672,49 @@ def main(argv=None) -> int:
                 print(f"=== skipped ({type(e).__name__}: {e}) ===\n{ln}",
                       file=sys.stderr)
             server.step()  # drain as we go so a bounded queue makes progress
-        server.run_until_drained()
+        rc = shutdown(server)
         for ln, h in handles:
             print(f"=== {h.request_id} ({h.finish_reason}) ===")
             print(ln + dataset.decode(h.tokens))
         print(json.dumps(server.summary()))
-        if args.metrics_json:
-            server.metrics.write_json(args.metrics_json)
-        if tserver is not None:
-            tserver.close()
-        return 0
+        return rc
 
     # REPL: one prompt per stdin line, streamed as it decodes
-    server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
-                             on_token=on_token, log_every=0,
-                             max_queue=args.queue_limit,
-                             default_deadline_s=args.deadline_s,
-                             registry=reg,
-                             **_server_kwargs(args))
+    server = build_backend(on_token)
     interactive = sys.stdin.isatty()
     if interactive:
         print("prompt> ", end="", flush=True)
-    for line in sys.stdin:
-        prompt = line.rstrip("\n")
-        if not prompt:
+    try:
+        for line in sys.stdin:
+            prompt = line.rstrip("\n")
+            if guard.stop_requested:
+                break
+            if not prompt:
+                if interactive:
+                    print("prompt> ", end="", flush=True)
+                continue
+            # one failing request must not tear down the REPL: report,
+            # reprompt
+            try:
+                sys.stdout.write(prompt)
+                server.submit(
+                    _request_for(args, dataset.encode(prompt), eos_id))
+                server.run_until_drained()
+                print()
+            except KeyboardInterrupt:
+                raise
+            except Exception as e:
+                print(f"\n[serve] request failed ({type(e).__name__}: {e}); "
+                      "still serving", file=sys.stderr)
+            if guard.stop_requested:
+                break
             if interactive:
                 print("prompt> ", end="", flush=True)
-            continue
-        # one failing request must not tear down the REPL: report, reprompt
-        try:
-            sys.stdout.write(prompt)
-            server.submit(_request_for(args, dataset.encode(prompt), eos_id))
-            server.run_until_drained()
-            print()
-        except KeyboardInterrupt:
-            raise
-        except Exception as e:
-            print(f"\n[serve] request failed ({type(e).__name__}: {e}); "
-                  "still serving", file=sys.stderr)
-        if interactive:
-            print("prompt> ", end="", flush=True)
-    if args.metrics_json:
-        server.metrics.write_json(args.metrics_json)
-    if tserver is not None:
-        tserver.close()
-    return 0
+    except KeyboardInterrupt:
+        # second SIGINT: skip further admission, still drain + flush below
+        print("\n[serve] interrupted again — draining and exiting",
+              file=sys.stderr)
+    return shutdown(server)
 
 
 if __name__ == "__main__":

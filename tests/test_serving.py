@@ -585,3 +585,93 @@ def test_llama_mode_serving_parity(cfg_params):
         [Request(prompt=p, max_new_tokens=6) for p in PROMPTS[:3]])
     for p, h in zip(PROMPTS[:3], handles):
         assert h.tokens == solo_greedy(params, cfg, p, 6)
+
+
+# ---------------------------------------------------------------------------
+# hardened validation, typed backpressure, mid-prefill expiry (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+
+def test_validation_rejects_malformed_sampling_params(cfg_params):
+    """Malformed requests bounce at the door with ValueError — a NaN
+    temperature must never reach the compiled sampler, where it would
+    silently poison its slot's logits."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=1)
+    bad = [
+        Request(prompt=[1], max_new_tokens=3, temperature=float("nan")),
+        Request(prompt=[1], max_new_tokens=3, temperature=float("inf")),
+        Request(prompt=[1], max_new_tokens=3, temperature=-0.5),
+        Request(prompt=[1], max_new_tokens=3, top_k=0),
+        Request(prompt=[1], max_new_tokens=3, top_p=0.0),
+        Request(prompt=[1], max_new_tokens=3, top_p=1.5),
+        Request(prompt=[1], max_new_tokens=3, top_p=float("nan")),
+        Request(prompt=[1], max_new_tokens=-2),
+        Request(prompt=[1], max_new_tokens=3, deadline_s=-1.0),
+        Request(prompt=[1], max_new_tokens=3, deadline_s=float("inf")),
+    ]
+    for r in bad:
+        with pytest.raises(ValueError):
+            server.submit(r)
+    assert server.metrics.requests_submitted == 0  # none were accepted
+
+
+def test_strict_window_rejects_instead_of_cropping(cfg_params):
+    """strict_window=True turns the documented crop/clamp semantics into
+    up-front rejection; the default server keeps cropping (covered by
+    test_long_prompt_cropped_and_max_new_clamped)."""
+    cfg, params = cfg_params
+    strict = InferenceServer(params, cfg, n_slots=1, strict_window=True)
+    with pytest.raises(ValueError):  # prompt longer than the window
+        strict.submit(Request(prompt=list(range(1, 41)), max_new_tokens=2))
+    with pytest.raises(ValueError):  # 30 + 4 - 1 > block_size=32
+        strict.submit(Request(prompt=list(range(1, 31)), max_new_tokens=4))
+    # an in-window request passes validation and still has full parity
+    h = strict.submit(Request(prompt=PROMPTS[0], max_new_tokens=4))
+    strict.run_until_drained(max_steps=100)
+    assert h.tokens == solo_greedy(params, cfg, PROMPTS[0], 4)
+
+
+def test_queue_full_error_carries_backpressure_payload(cfg_params):
+    """QueueFullError is typed backpressure: it reports the observed
+    queue depth and a suggested retry-after, and the rejection lands in
+    mingpt_serving_rejected_total{reason="queue_full"}."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=1, max_queue=1)
+    server.submit(Request(prompt=PROMPTS[0], max_new_tokens=3))
+    with pytest.raises(QueueFullError) as ei:
+        server.submit(Request(prompt=PROMPTS[1], max_new_tokens=3))
+    err = ei.value
+    assert err.queue_depth == 1
+    assert err.retry_after_s is not None and err.retry_after_s >= 0.05
+    assert server.metrics.rejected_by_reason["queue_full"] == 1
+    server.run_until_drained(max_steps=100)
+
+
+def test_deadline_expiry_mid_prefill_frees_slot_and_counts(cfg_params):
+    """A request whose deadline passes while its prompt is still
+    prefilling in chunks must release its slot (and any prefix-cache
+    bookkeeping) at the next round and count as expired — a slow caller
+    can't strand a half-prefilled KV lane."""
+    cfg, params = cfg_params
+    t = {"now": 0.0}
+    server = InferenceServer(params, cfg, n_slots=1, prefill_chunk=4,
+                             prefix_cache_mb=1.0, clock=lambda: t["now"])
+    prompt = list(range(1, 21))  # 20 tokens -> 5 chunks of 4
+    h = server.submit(Request(prompt=prompt, max_new_tokens=4,
+                              deadline_s=5.0))
+    server.step()  # admitted + exactly one chunk: caught mid-prefill
+    assert h.slot is not None and h.prefilling
+    assert 0 < h.prefill_pos < len(prompt)
+    assert server.engine.pool.free_count == 0
+    t["now"] = 6.0
+    server.step()  # deadline sweep runs before admission
+    assert h.finished and h.finish_reason == "deadline"
+    assert h.slot is None and not h.prefilling
+    assert h.tokens == []  # never reached its first token
+    assert server.engine.pool.free_count == 1  # lane fully released
+    assert server.metrics.requests_expired == 1
+    # the freed lane serves the next request with full parity
+    h2 = server.submit(Request(prompt=PROMPTS[1], max_new_tokens=4))
+    server.run_until_drained(max_steps=100)
+    assert h2.tokens == solo_greedy(params, cfg, PROMPTS[1], 4)
