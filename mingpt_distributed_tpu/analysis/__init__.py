@@ -1,0 +1,47 @@
+"""graftlint — repo-specific JAX-aware static analysis (ISSUE 8 tentpole).
+
+Three of this repo's worst bugs were invariant violations no generic
+linter can see: donating externally-restored arrays into a
+persistent-cache-deserialised executable (the PR 2 resume segfault),
+silent recompiles that needed a *runtime* watchdog to catch (PR 4), and
+the serving chaos harness only staying deterministic because serving
+code never reads the wall clock directly (PR 5). Each of those
+invariants was enforced by convention; ``graftlint`` enforces them at
+review time, before a trace ever runs.
+
+The engine is plain ``ast`` — no imports of the analysed code, so a
+lint run can never initialise a JAX backend or dial TPU hardware — with
+a rule registry (stable ``GLxxx`` IDs), inline
+``# graftlint: disable=GLxxx`` suppressions, a checked-in baseline for
+grandfathered findings, human and ``--json`` output, and deterministic
+exit codes (0 clean, 1 findings, 2 usage error).
+
+Usage::
+
+    python -m mingpt_distributed_tpu.analysis mingpt_distributed_tpu tools *.py
+    python -m mingpt_distributed_tpu.analysis --json --baseline lint_baseline.json
+    python -m mingpt_distributed_tpu.analysis --list-rules
+
+Rule catalog: ``docs/static_analysis.md``.
+"""
+
+from mingpt_distributed_tpu.analysis.core import (
+    Config,
+    Finding,
+    Rule,
+    all_rules,
+    get_rule,
+    register_rule,
+)
+from mingpt_distributed_tpu.analysis.engine import Engine, RunResult
+
+__all__ = [
+    "Config",
+    "Engine",
+    "Finding",
+    "Rule",
+    "RunResult",
+    "all_rules",
+    "get_rule",
+    "register_rule",
+]

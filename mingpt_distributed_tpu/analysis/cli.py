@@ -1,0 +1,99 @@
+"""graftlint CLI.
+
+Exit codes (stable, gate on them):
+  0  no unsuppressed, unbaselined findings
+  1  findings (or unparseable source)
+  2  usage error (unknown rule id, unreadable baseline)
+
+``--json`` emits the ``graftlint/1`` envelope on stdout — the same
+"versioned schema on one line of contract" idiom as the telemetry JSONL
+export, so ``tools/trace_summary.py``-style consumers can ingest
+findings without screen-scraping the human report.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from mingpt_distributed_tpu.analysis.core import (
+    EXIT_USAGE, Baseline, all_rules,
+)
+from mingpt_distributed_tpu.analysis.engine import Engine
+
+DEFAULT_BASELINE = "lint_baseline.json"
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m mingpt_distributed_tpu.analysis",
+        description="graftlint: repo-specific JAX-aware static analysis "
+                    "(rule catalog: docs/static_analysis.md)",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/dirs to lint (default: "
+                        "mingpt_distributed_tpu tools *.py)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit the graftlint/1 JSON envelope")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"baseline file (default: ./{DEFAULT_BASELINE} "
+                        f"when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--select", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--show-suppressed", action="store_true",
+                   help="include suppressed/baselined findings in the "
+                        "human report")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def default_paths() -> List[str]:
+    """The repo sweep: the package, tools/, and top-level scripts."""
+    out = []
+    for p in ("mingpt_distributed_tpu", "tools"):
+        if os.path.isdir(p):
+            out.append(p)
+    out.extend(sorted(
+        f for f in os.listdir(".")
+        if f.endswith(".py") and os.path.isfile(f)))
+    return out or ["."]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for cls in all_rules():
+            print(f"{cls.id}  {cls.name:<18} {cls.help}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline:
+        path = args.baseline or (
+            DEFAULT_BASELINE if os.path.isfile(DEFAULT_BASELINE) else None)
+        if path is not None:
+            try:
+                baseline = Baseline.load(path)
+            except (OSError, ValueError) as e:
+                print(f"graftlint: bad baseline: {e}", file=sys.stderr)
+                return EXIT_USAGE
+
+    select = [s for s in (args.select or "").split(",") if s.strip()] or None
+    try:
+        engine = Engine(baseline=baseline, select=select)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return EXIT_USAGE
+
+    result = engine.run(args.paths or default_paths())
+    if args.as_json:
+        print(json.dumps(result.to_json(), indent=2, sort_keys=True))
+    else:
+        print(result.render_human(show_suppressed=args.show_suppressed))
+    return result.exit_code

@@ -1,0 +1,307 @@
+"""Shared JAX-awareness for graftlint rules: which functions in a module
+get jitted, which of their parameters are static, and which expressions
+are traced values.
+
+The resolution is deliberately module-local and name-based:
+
+* a ``FunctionDef``/``Lambda`` is *jitted* when it is decorated with
+  ``@jax.jit`` / ``@jit`` / ``@partial(jax.jit, ...)``, or its name (or
+  the lambda itself, or a ``functools.partial(name, ...)`` wrapper over
+  its name) is passed to a ``jax.jit(...)`` call anywhere in the same
+  module;
+* parameters named in ``static_argnames`` or indexed by
+  ``static_argnums`` are *static* — branching or string-formatting on
+  them re-traces by design and is not a finding;
+* keyword arguments bound by a ``functools.partial`` wrapper are
+  treated as static too (``partial(_prefill_impl, cfg=cfg)`` makes
+  ``cfg`` a closure constant of the trace, exactly like a static
+  argname).
+
+Factory-made steps (``jax.jit(make_train_step(cfg, ...))``) are *not*
+resolved — the jitted callable is the return value of a call, and
+chasing it would need real interprocedural analysis for marginal gain:
+every factory in this repo returns a closure whose body is covered the
+day it's decorated directly. Fewer false positives beats fake recall.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple, Union
+
+FuncNode = Union[ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda]
+
+#: attribute reads on a traced array that yield trace-time-concrete
+#: Python values (shapes are static under jit) — taint stops here
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "aval"}
+
+
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call target: ``jax.jit`` -> "jax.jit",
+    ``self._f`` -> "self._f"; "" when not a simple dotted chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_jax_jit(node: ast.AST) -> bool:
+    """``jax.jit`` / bare ``jit`` (from ``jax import jit``)."""
+    return call_name(node) in ("jax.jit", "jit")
+
+
+def is_partial(node: ast.AST) -> bool:
+    return call_name(node) in ("functools.partial", "partial")
+
+
+def _const_strs(node: ast.AST) -> Set[str]:
+    """String constants inside a tuple/list/constant node."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+    return out
+
+
+def _const_ints(node: ast.AST) -> Set[int]:
+    out: Set[int] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, int):
+            out.add(n.value)
+    return out
+
+
+def jit_keywords(call: ast.Call) -> Dict[str, ast.AST]:
+    return {kw.arg: kw.value for kw in call.keywords if kw.arg}
+
+
+@dataclass
+class JittedFn:
+    """One function that will be traced, with its staticness facts."""
+
+    node: FuncNode
+    static_names: Set[str] = field(default_factory=set)
+    static_nums: Set[int] = field(default_factory=set)
+    partial_bound: Set[str] = field(default_factory=set)
+    donate_nums: Set[int] = field(default_factory=set)
+    donate_names: Set[str] = field(default_factory=set)
+    bound_to: str = ""        # "self._train_step" / "step_fn" / ""
+    jit_call: Optional[ast.Call] = None
+
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    def positional_params(self) -> List[str]:
+        a = self.node.args
+        return [p.arg for p in a.posonlyargs + a.args]
+
+    def traced_params(self) -> Set[str]:
+        pos = self.positional_params()
+        static = set(self.static_names) | set(self.partial_bound)
+        for i in sorted(self.static_nums):
+            if 0 <= i < len(pos):
+                static.add(pos[i])
+        return {p for p in self.params() if p not in static}
+
+    def donated_params(self) -> Set[str]:
+        pos = self.positional_params()
+        out = set(self.donate_names)
+        for i in sorted(self.donate_nums):
+            if 0 <= i < len(pos):
+                out.add(pos[i])
+        return out
+
+
+def _apply_jit_kwargs(fn: JittedFn, call: ast.Call) -> None:
+    kw = jit_keywords(call)
+    if "static_argnames" in kw:
+        fn.static_names |= _const_strs(kw["static_argnames"])
+    if "static_argnums" in kw:
+        fn.static_nums |= _const_ints(kw["static_argnums"])
+    if "donate_argnums" in kw:
+        fn.donate_nums |= _const_ints(kw["donate_argnums"])
+    if "donate_argnames" in kw:
+        fn.donate_names |= _const_strs(kw["donate_argnames"])
+
+
+def _assign_target_key(node: ast.AST) -> str:
+    """ "name" / "self.attr" keys for taint + callable tracking."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _assign_target_key(node.value)
+        return f"{base}.{node.attr}" if base else ""
+    return ""
+
+
+def collect_jitted(tree: ast.Module) -> List[JittedFn]:
+    """Every jitted function resolvable within this module."""
+    # name -> def node, innermost-last so later defs shadow earlier ones
+    defs: Dict[str, FuncNode] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[n.name] = n
+
+    out: List[JittedFn] = []
+    seen: Set[int] = set()
+
+    def add(node: FuncNode, call: Optional[ast.Call],
+            bound_to: str = "") -> JittedFn:
+        fn = JittedFn(node=node, bound_to=bound_to, jit_call=call)
+        if call is not None:
+            _apply_jit_kwargs(fn, call)
+        out.append(fn)
+        seen.add(id(node))
+        return fn
+
+    # 1) decorators
+    for n in ast.walk(tree):
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for dec in n.decorator_list:
+            if is_jax_jit(dec):
+                add(n, None, bound_to=n.name)
+            elif (isinstance(dec, ast.Call) and is_partial(dec)
+                    and dec.args and is_jax_jit(dec.args[0])):
+                add(n, dec, bound_to=n.name)
+            elif isinstance(dec, ast.Call) and is_jax_jit(dec.func):
+                add(n, dec, bound_to=n.name)
+
+    # 2) jax.jit(<target>, ...) calls
+    for n in ast.walk(tree):
+        if not (isinstance(n, ast.Call) and is_jax_jit(n.func) and n.args):
+            continue
+        target = n.args[0]
+        partial_bound: Set[str] = set()
+        if isinstance(target, ast.Call) and is_partial(target) and target.args:
+            partial_bound = {kw.arg for kw in target.keywords if kw.arg}
+            target = target.args[0]
+        node: Optional[FuncNode] = None
+        if isinstance(target, ast.Lambda):
+            node = target
+        elif isinstance(target, ast.Name):
+            node = defs.get(target.id)
+        if node is None or id(node) in seen:
+            # still record kwargs for an already-seen def (a second jit
+            # wrapper over the same fn, e.g. sliding vs cached generate)
+            if node is not None:
+                for fn in out:
+                    if fn.node is node:
+                        _apply_jit_kwargs(fn, n)
+            continue
+        fn = add(node, n)
+        fn.partial_bound = partial_bound
+    return out
+
+
+def donated_bindings(tree: ast.Module) -> Dict[str, Tuple[ast.Call, Set[int]]]:
+    """Assignments binding a donating jit to a name:
+    ``self._step = jax.jit(..., donate_argnums=(0,))`` ->
+    {"self._step": (call, {0})}. Keys are later matched against call
+    sites by the donation rule."""
+    out: Dict[str, Tuple[ast.Call, Set[int]]] = {}
+    for n in ast.walk(tree):
+        if not isinstance(n, ast.Assign) or not isinstance(n.value, ast.Call):
+            continue
+        call = n.value
+        if not is_jax_jit(call.func):
+            continue
+        kw = jit_keywords(call)
+        if "donate_argnums" not in kw and "donate_argnames" not in kw:
+            continue
+        nums = _const_ints(kw["donate_argnums"]) if "donate_argnums" in kw \
+            else set()
+        for t in n.targets:
+            key = _assign_target_key(t)
+            if key:
+                out[key] = (call, nums)
+    return out
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """All dotted-name keys an expression *reads*: {"x", "self.state",
+    "self"} for ``f(x, self.state)`` — attribute chains contribute both
+    the full key and their base name."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            key = _assign_target_key(n)
+            if key:
+                out.add(key)
+    return out
+
+
+class TracedTaint:
+    """Which local names hold traced values inside one jitted function.
+
+    Seeds: the non-static parameters. Propagation: a simple fixpoint
+    over ``Assign``/``AugAssign`` — a target becomes traced when its RHS
+    reads a traced name, EXCEPT through the static attribute ring
+    (``x.shape``/``x.dtype``…) and ``len()``, which are concrete at
+    trace time. Nested ``def``s (scan/cond bodies) contribute their own
+    params as traced.
+    """
+
+    def __init__(self, fn: JittedFn):
+        self.traced: Set[str] = set(fn.traced_params())
+        body = fn.node.body if isinstance(fn.node.body, list) \
+            else [fn.node.body]
+        for sub in ast.walk(fn.node):
+            if sub is fn.node:
+                continue
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                a = sub.args
+                for p in a.posonlyargs + a.args + a.kwonlyargs:
+                    self.traced.add(p.arg)
+        changed = True
+        while changed:
+            changed = False
+            for stmt in ast.walk(fn.node):
+                targets: List[ast.AST] = []
+                value: Optional[ast.AST] = None
+                if isinstance(stmt, ast.Assign):
+                    targets, value = stmt.targets, stmt.value
+                elif isinstance(stmt, ast.AugAssign):
+                    targets, value = [stmt.target], stmt.value
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    targets, value = [stmt.target], stmt.value
+                if value is None or not self.expr_traced(value):
+                    continue
+                for t in targets:
+                    for el in ast.walk(t):
+                        if isinstance(el, ast.Name) \
+                                and el.id not in self.traced:
+                            self.traced.add(el.id)
+                            changed = True
+        del body
+
+    def expr_traced(self, node: ast.AST) -> bool:
+        """Does this expression (transitively) read a traced value —
+        without passing through a shape/dtype escape hatch?"""
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return False
+            return self.expr_traced(node.value)
+        if isinstance(node, ast.Call):
+            fname = call_name(node.func)
+            if fname == "len":  # len(x) == x.shape[0]: static
+                return False
+            return any(self.expr_traced(a) for a in node.args) or any(
+                self.expr_traced(kw.value) for kw in node.keywords)
+        if isinstance(node, ast.Name):
+            return node.id in self.traced
+        return any(self.expr_traced(c) for c in ast.iter_child_nodes(node))

@@ -1,0 +1,11 @@
+"""Rule battery. Importing this package registers every rule; the
+modules are imported in ID order so ``--list-rules`` output is stable."""
+
+from mingpt_distributed_tpu.analysis.rules import (  # noqa: F401
+    donation,
+    recompile,
+    tracer_leak,
+    clock,
+    metric_names,
+    print_discipline,
+)

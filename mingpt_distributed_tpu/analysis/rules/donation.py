@@ -1,0 +1,190 @@
+"""GL001 — donated-restore: a donating jit callable may be fed
+externally-created arrays.
+
+The motivating incident (PR 2, the seed tier-1 segfault): the trainer's
+``_train_step`` donates its state (``donate_argnums=(0,)``); after a
+resume, that state held arrays built host-side from a restored
+checkpoint (``jax.make_array_from_callback`` over msgpack bytes).
+Donating an externally-created array into an executable deserialised
+from the persistent compilation cache corrupts the heap on jaxlib
+0.4.36 CPU — a segfault far from the cause. The fix is the trainer's
+*laundering idiom*: pass restored state through one compiled, undonated
+copy (``jax.jit(lambda s: jax.tree.map(jnp.copy, s))``) so the donating
+step only ever consumes executable-owned buffers.
+
+This rule does module/class-local taint tracking:
+
+* **sources** — calls whose name looks like deserialisation
+  (``restore*``, ``load*``, ``*deserialize*``, ``from_bytes``,
+  ``make_array_from_callback``, ``frombuffer``);
+* **propagation** — flow-insensitive over ``name`` and ``self.attr``
+  assignment keys (if any assignment taints a key, the key is tainted);
+* **laundering** — a value returned by an immediately-invoked,
+  non-donating ``jax.jit(...)(x)`` call, or by a function whose name
+  contains ``launder`` or ``copy``, is clean;
+* **sink** — a call through a name bound to ``jax.jit(...,
+  donate_argnums=...)`` whose argument at a donated position reads a
+  tainted key.
+
+Cross-module flows (serve.py restores, engine donates) are out of
+scope by design: the engine only ever donates its own pool cache, and
+the rule's job is the same-class pattern that actually bit us.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Set, Tuple
+
+from mingpt_distributed_tpu.analysis.core import (
+    FileContext, Finding, Rule, register_rule,
+)
+from mingpt_distributed_tpu.analysis.jitutil import (
+    call_name, donated_bindings, is_jax_jit, jit_keywords, names_in,
+)
+
+_RESTORE_RE = re.compile(
+    r"(^|[._])(restore\w*|load\w*|\w*deserialize\w*|from_bytes|"
+    r"frombuffer|make_array_from_callback)$")
+_LAUNDER_RE = re.compile(r"(launder|copy)", re.IGNORECASE)
+
+
+def _is_restore_call(node: ast.Call) -> bool:
+    return bool(_RESTORE_RE.search(call_name(node.func) or ""))
+
+
+def _is_laundering_call(node: ast.Call) -> bool:
+    """Immediately-invoked undonated jit — ``jax.jit(f, ...)(x)`` — or a
+    call into something named like a copy/launder helper."""
+    if isinstance(node.func, ast.Call) and is_jax_jit(node.func.func):
+        return "donate_argnums" not in jit_keywords(node.func)
+    return bool(_LAUNDER_RE.search(call_name(node.func) or ""))
+
+
+def _target_keys(node: ast.AST) -> Set[str]:
+    """Keys an assignment target binds. An attribute target taints ONLY
+    its dotted key — ``self.rng = tainted`` must not taint bare ``self``
+    (which would transitively taint every ``self.*`` read)."""
+    if isinstance(node, ast.Name):
+        return {node.id}
+    if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+        return {f"{node.value.id}.{node.attr}"}
+    if isinstance(node, (ast.Tuple, ast.List)):
+        keys: Set[str] = set()
+        for el in node.elts:
+            keys |= _target_keys(el)
+        return keys
+    if isinstance(node, ast.Starred):
+        return _target_keys(node.value)
+    if isinstance(node, ast.Subscript):
+        # container[k] = tainted taints the container key
+        return _target_keys(node.value)
+    return set()
+
+
+class _Region:
+    """One taint region: a ClassDef (all methods pooled — restored state
+    regularly crosses ``self.*`` between __init__ and the step loop) or
+    the module minus its classes."""
+
+    def __init__(self, stmts: List[ast.stmt]):
+        self.assigns: List[Tuple[Set[str], ast.AST]] = []
+        self.calls: List[ast.Call] = []
+        # keys bound to ANY jax.jit(...) — calling through one returns
+        # executable-owned buffers, so taint never flows out of it (the
+        # step's own output state is exactly what donation is FOR)
+        self.jit_bound: Set[str] = set()
+        for stmt in stmts:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Assign):
+                    tk: Set[str] = set()
+                    for t in n.targets:
+                        tk |= _target_keys(t)
+                    self.assigns.append((tk, n.value))
+                    if isinstance(n.value, ast.Call) \
+                            and is_jax_jit(n.value.func):
+                        self.jit_bound |= tk
+                elif isinstance(n, ast.Call):
+                    self.calls.append(n)
+
+    def _expr_tainted(self, node: ast.AST, tainted: Set[str]) -> bool:
+        if isinstance(node, ast.Call):
+            if _is_laundering_call(node):
+                return False
+            if call_name(node.func) in self.jit_bound:
+                return False
+            if _is_restore_call(node):
+                return True
+            return any(self._expr_tainted(a, tainted) for a in node.args) \
+                or any(self._expr_tainted(kw.value, tainted)
+                       for kw in node.keywords)
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            return bool(names_in(node) & tainted)
+        return any(self._expr_tainted(c, tainted)
+                   for c in ast.iter_child_nodes(node))
+
+    def tainted_keys(self) -> Set[str]:
+        tainted: Set[str] = set()
+        changed = True
+        while changed:
+            changed = False
+            for keys, value in self.assigns:
+                if keys <= tainted:
+                    continue
+                if self._expr_tainted(value, tainted):
+                    tainted |= keys
+                    changed = True
+        return tainted
+
+    def expr_tainted(self, node: ast.AST, tainted: Set[str]) -> bool:
+        return self._expr_tainted(node, tainted)
+
+
+@register_rule
+class DonatedRestoreRule(Rule):
+    id = "GL001"
+    name = "donated-restore"
+    help = ("a jit with donate_argnums receives restored/deserialised "
+            "arrays that never passed through a compiled undonated copy "
+            "(the PR 2 resume-segfault class)")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        donors: Dict[str, Tuple[ast.Call, Set[int]]] = \
+            donated_bindings(ctx.tree)
+        if not donors:
+            return []
+        regions: List[List[ast.stmt]] = []
+        module_stmts: List[ast.stmt] = []
+        for stmt in ctx.tree.body:
+            if isinstance(stmt, ast.ClassDef):
+                regions.append(stmt.body)
+            else:
+                module_stmts.append(stmt)
+        regions.append(module_stmts)
+
+        findings: List[Finding] = []
+        for stmts in regions:
+            region = _Region(stmts)
+            tainted = region.tainted_keys()
+            if not tainted:
+                continue
+            for call in region.calls:
+                key = call_name(call.func)
+                if key not in donors:
+                    continue
+                _, donated_positions = donors[key]
+                for pos in sorted(donated_positions):
+                    if pos >= len(call.args):
+                        continue
+                    arg = call.args[pos]
+                    if region.expr_tainted(arg, tainted):
+                        hot = sorted(names_in(arg) & tainted) or ["<expr>"]
+                        findings.append(self.finding(
+                            ctx, call,
+                            f"donated argument {pos} of {key}() may hold "
+                            f"restored/deserialised arrays ({', '.join(hot)}) "
+                            f"— launder through a compiled undonated copy "
+                            f"first (jax.jit(lambda s: jax.tree.map("
+                            f"jnp.copy, s)))"))
+        return findings

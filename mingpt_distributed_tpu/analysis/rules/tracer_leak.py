@@ -1,0 +1,115 @@
+"""GL006 — tracer-leak: traced values escaping a jitted function
+through ``self``/globals.
+
+A tracer stored on ``self`` or a module-level container during tracing
+outlives the trace: the *first* call writes a tracer object (not an
+array) into long-lived host state, and every later read either crashes
+with the infamous ``UnexpectedTracerError`` or — when the slot is only
+read under another trace — silently freezes the first call's value.
+The serving engine keeps all cross-step state in explicit carry values
+(cache in, cache out) precisely to avoid this; this rule makes that
+discipline checkable.
+
+Flagged inside jitted code:
+
+* ``self.<attr> = <expr reading a traced value>`` (and ``+=`` etc.);
+* ``global``/``nonlocal`` declarations (a traced function mutating
+  outer scope is the same escape with fewer steps);
+* subscript stores into names not local to the jitted function
+  (``CACHE[k] = traced``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from mingpt_distributed_tpu.analysis.core import (
+    FileContext, Finding, Rule, register_rule,
+)
+from mingpt_distributed_tpu.analysis.jitutil import TracedTaint, collect_jitted
+
+
+def _local_names(fn_node: ast.AST) -> Set[str]:
+    """Names assigned (or bound as params) anywhere inside the function
+    — stores into anything else leave the trace."""
+    out: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(n.name)
+            a = n.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                out.add(p.arg)
+            if a.vararg:
+                out.add(a.vararg.arg)
+            if a.kwarg:
+                out.add(a.kwarg.arg)
+        elif isinstance(n, ast.Lambda):
+            a = n.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                out.add(p.arg)
+        elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+        elif isinstance(n, (ast.For, ast.AsyncFor)):
+            for el in ast.walk(n.target):
+                if isinstance(el, ast.Name):
+                    out.add(el.id)
+    if isinstance(fn_node, ast.Lambda):
+        a = fn_node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            out.add(p.arg)
+    return out
+
+
+@register_rule
+class TracerLeakRule(Rule):
+    id = "GL006"
+    name = "tracer-leak"
+    help = ("a traced value is stored to self./globals from inside a "
+            "jitted function — tracers must never outlive their trace; "
+            "return the value through the function's outputs")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in collect_jitted(ctx.tree):
+            taint = TracedTaint(fn)
+            locals_ = _local_names(fn.node)
+            for n in ast.walk(fn.node):
+                if isinstance(n, (ast.Global, ast.Nonlocal)):
+                    findings.append(self.finding(
+                        ctx, n,
+                        f"{'global' if isinstance(n, ast.Global) else 'nonlocal'} "
+                        f"declaration inside a jitted function — traced "
+                        f"code must not mutate outer scope"))
+                    continue
+                targets: List[ast.AST] = []
+                value: ast.AST = None
+                if isinstance(n, ast.Assign):
+                    targets, value = n.targets, n.value
+                elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [n.target]
+                    value = n.value if n.value is not None else None
+                if not targets or value is None \
+                        or not taint.expr_traced(value):
+                    continue
+                for t in targets:
+                    leak = None
+                    if isinstance(t, ast.Attribute):
+                        base = t.value
+                        if isinstance(base, ast.Name) \
+                                and base.id not in locals_ - {"self"}:
+                            leak = f"{base.id}.{t.attr}"
+                    elif isinstance(t, ast.Subscript):
+                        base = t.value
+                        if isinstance(base, ast.Name) \
+                                and base.id not in locals_:
+                            leak = f"{base.id}[...]"
+                    if leak:
+                        findings.append(self.finding(
+                            ctx, n,
+                            f"traced value stored to {leak} inside a "
+                            f"jitted function — the tracer outlives its "
+                            f"trace (UnexpectedTracerError or a frozen "
+                            f"first-call value); thread it through the "
+                            f"return value instead"))
+        return findings

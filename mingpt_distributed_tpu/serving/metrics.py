@@ -32,6 +32,7 @@ from mingpt_distributed_tpu.telemetry import (
     LATENCY_BUCKETS_S,
     MetricsRegistry,
     RateWindow,
+    log_event,
 )
 
 
@@ -301,7 +302,7 @@ class ServingMetrics:
             self._tokens_per_sec = rate
             self._tps.set(rate)
         if self.enabled and self.log_every and self.steps % self.log_every == 0:
-            print(self.log_line(), flush=True)
+            log_event(self.log_line())
 
     # -- read-out ------------------------------------------------------
     @property

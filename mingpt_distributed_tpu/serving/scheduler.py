@@ -91,6 +91,7 @@ from mingpt_distributed_tpu.telemetry import (
     MetricsRegistry,
     RecompileWatchdog,
     SpanTracer,
+    log_event,
 )
 
 
@@ -293,10 +294,11 @@ class InferenceServer:
                 self.on_token(handle, token)
             except Exception as e:  # the callback is user code: isolate it
                 handle.error = e
-                print(
+                log_event(
                     f"[serve] on_token callback raised for "
                     f"{handle.request_id}: {e!r} — retiring request, "
-                    f"freeing its slot", flush=True,
+                    f"freeing its slot",
+                    tracer=self.tracer, request_id=handle.request_id,
                 )
                 return False
         return True

@@ -39,6 +39,8 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import fsspec
 
+from mingpt_distributed_tpu.telemetry import log_event
+
 MANIFEST_VERSION = 1
 MANIFEST_SUFFIX = ".manifest.json"
 
@@ -148,10 +150,11 @@ def with_retries(
                 delay = next(delays)
             except StopIteration:
                 raise e
-            print(
+            log_event(
                 f"[durability] transient {op} error "
                 f"(attempt {attempt}/{policy.attempts}): {e!r}; "
-                f"retrying in {delay:.2f}s"
+                f"retrying in {delay:.2f}s",
+                op=op, attempt=attempt,
             )
             policy.sleep(delay)
             attempt += 1
@@ -368,9 +371,10 @@ def read_verified(
             )
             continue
         if failures:
-            print(
+            log_event(
                 "[durability] fell back to checkpoint "
-                f"step {entry.step} after: " + "; ".join(failures)
+                f"step {entry.step} after: " + "; ".join(failures),
+                step=entry.step,
             )
         return blob, entry
     raise SnapshotIntegrityError(

@@ -50,7 +50,7 @@ import io
 import os
 import time
 from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from typing import Any, Callable, List, Optional
 
 import fsspec
 from fsspec import AbstractFileSystem
@@ -145,12 +145,15 @@ class _FaultyWriteFile(io.BytesIO):
     writes half the bytes and reports success (silent corruption, the
     digest check's job to catch); ``error`` writes nothing and raises."""
 
-    def __init__(self, target_fs, path: str, mode: Optional[str], delay_s: float):
+    def __init__(self, target_fs, path: str, mode: Optional[str],
+                 delay_s: float,
+                 sleep: Callable[[float], None] = time.sleep):
         super().__init__()
         self._target_fs = target_fs
         self._path = path
         self._fault = mode
         self._delay_s = delay_s
+        self._sleep = sleep
         self._done = False
 
     def close(self):
@@ -162,7 +165,7 @@ class _FaultyWriteFile(io.BytesIO):
         if self._fault == "error":
             raise _injected_error("write", self._path)
         if self._fault == "delay":
-            time.sleep(self._delay_s)
+            self._sleep(self._delay_s)
         if self._fault == "truncate":
             blob = blob[: len(blob) // 2]
         with self._target_fs.open(self._path, "wb") as f:
@@ -184,9 +187,13 @@ class FaultInjectionFileSystem(AbstractFileSystem):
         faults: Optional[str] = None,
         target_protocol: Optional[str] = None,
         target_options: Optional[dict] = None,
+        sleep: Callable[[float], None] = time.sleep,
         **kwargs: Any,
     ):
         super().__init__(**kwargs)
+        # injectable like durability.RetryPolicy.sleep — delay faults
+        # become instantaneous (and assertable) under a fake sleep
+        self.sleep = sleep
         self.target = fsspec.filesystem(
             target_protocol or os.environ.get(ENV_TARGET, "file"),
             **(target_options or {}),
@@ -227,6 +234,7 @@ class FaultInjectionFileSystem(AbstractFileSystem):
                 self.target, path,
                 spec.mode if spec else None,
                 spec.delay_s if spec else 0.0,
+                sleep=self.sleep,
             )
         spec = self._fault_for("read", path)
         if spec is not None:
@@ -236,7 +244,7 @@ class FaultInjectionFileSystem(AbstractFileSystem):
             if spec.mode == "error":
                 raise _injected_error("read", path)
             if spec.mode == "delay":
-                time.sleep(spec.delay_s)
+                self.sleep(spec.delay_s)
             if spec.mode == "truncate":
                 with self.target.open(path, "rb") as f:
                     blob = f.read()

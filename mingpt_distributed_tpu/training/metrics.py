@@ -27,6 +27,7 @@ from mingpt_distributed_tpu.telemetry import (  # noqa: F401 — re-exports
     JsonlEventSink,
     MetricsRegistry,
     RateWindow,
+    log_event,
     peak_flops_per_chip,
     peak_hbm_bytes_per_chip,
 )
@@ -87,7 +88,7 @@ class MetricsLogger:
 
                 self._tb = SummaryWriter(log_dir=tensorboard_dir)
             except Exception as e:  # optional dep — degrade to other sinks
-                print(f"tensorboard sink unavailable ({e}); continuing")
+                log_event(f"tensorboard sink unavailable ({e}); continuing")
         self._rate = RateWindow()
         self._peak = peak_flops_per_chip()
         self._step_gauge = self.registry.gauge(
@@ -134,7 +135,7 @@ class MetricsLogger:
             parts = [f"step {step}"] + [
                 f"{k} {v:.4g}" for k, v in rec.items() if k != "step"
             ]
-            print(" | ".join(parts), flush=True)
+            log_event(" | ".join(parts), step=step)
             if self._jsonl:
                 self._jsonl.write("train_step", dict(rec))
             if self._tb:

@@ -396,7 +396,8 @@ class GPTTrainer:
             registry=metrics_registry,
         )
         if self.is_writer:
-            print(gpt.model_size_report(self.state["params"], gpt_config))
+            log_event(gpt.model_size_report(self.state["params"], gpt_config),
+                      tracer=self.tracer)
 
     # ------------------------------------------------------------------
     def _fresh_state(self, rng) -> TrainState:
@@ -596,7 +597,10 @@ class GPTTrainer:
             ):
                 last["eval_loss"] = self.evaluate()
                 if self.is_writer:
-                    print(f"epoch {epoch} | eval_loss {last['eval_loss']:.4f}")
+                    log_event(
+                        f"epoch {epoch} | eval_loss {last['eval_loss']:.4f}",
+                        tracer=self.tracer, epoch=epoch,
+                    )
             if stop or (epoch + 1) % cfg.save_every == 0:
                 self.save_snapshot(epoch_done)
             if stop:

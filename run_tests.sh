@@ -20,6 +20,14 @@
 set -euo pipefail
 cd "$(dirname "$0")"
 
+# Static-analysis gate (ISSUE 8): graftlint over the package, tools/
+# and the top-level scripts. Pure-ast (no JAX backend, sub-second);
+# fails on any finding that is neither inline-suppressed nor
+# grandfathered in lint_baseline.json. Rule catalog:
+# docs/static_analysis.md.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python -m mingpt_distributed_tpu.analysis
+
 has_m=0
 for a in "$@"; do
   [[ "$a" == "-m" ]] && has_m=1

@@ -1,0 +1,45 @@
+"""GL001 fixtures — donated-restore.
+
+Positive: a donating step fed state straight off a restore.
+Suppressed: same shape, inline disable.
+Negative: the trainer's laundering idiom (compiled undonated copy).
+
+NOTE: the ``# expect: GLxxx`` trailers are read by
+tests/test_graftlint.py — every marked line must produce exactly that
+active finding, and no unmarked line may produce any.
+"""
+import jax
+import jax.numpy as jnp
+
+
+class BadTrainer:
+    def __init__(self, step_fn, path):
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self.state = restore_snapshot(path)
+
+    def step(self, batch):
+        self.state, m = self._step(self.state, batch)  # expect: GL001
+        return m
+
+
+class SuppressedTrainer:
+    def __init__(self, step_fn, path):
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        self.state = restore_snapshot(path)
+
+    def step(self, batch):
+        self.state, m = self._step(self.state, batch)  # graftlint: disable=GL001
+        return m
+
+
+class GoodTrainer:
+    def __init__(self, step_fn, path):
+        self._step = jax.jit(step_fn, donate_argnums=(0,))
+        placed = restore_snapshot(path)
+        # the laundering idiom: one compiled, undonated copy makes the
+        # buffers executable-owned before the donating step sees them
+        self.state = jax.jit(lambda s: jax.tree.map(jnp.copy, s))(placed)
+
+    def step(self, batch):
+        self.state, m = self._step(self.state, batch)
+        return m

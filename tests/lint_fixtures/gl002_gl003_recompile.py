@@ -1,0 +1,56 @@
+"""GL002/GL003 fixtures — traced coercion and traced branching.
+
+Positives: f-string/str() on a traced value; if/while on a traced test.
+Suppressed: one of each, inline disable.
+Negatives: branching/formatting on static args and on ``.shape``
+products — both trace-time-concrete by design.
+"""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def coerces_fstring(x):
+    label = f"value={x}"  # expect: GL002
+    return x + 1, label
+
+
+@jax.jit
+def coerces_str(x):
+    return str(x)  # expect: GL002
+
+
+@jax.jit
+def coerces_suppressed(x):
+    return str(x)  # graftlint: disable=GL002
+
+
+@jax.jit
+def shape_is_static(x):
+    b, t = x.shape
+    tag = f"batch={b}"  # clean: .shape products are concrete under trace
+    del tag
+    return x.reshape(b * t)
+
+
+@jax.jit
+def branches_if(x):
+    if x > 0:  # expect: GL003
+        return x
+    return -x
+
+
+@jax.jit
+def branches_while(x):
+    while x < 0:  # graftlint: disable=GL003
+        x = x + 1
+    return x
+
+
+@partial(jax.jit, static_argnames=("flag",))
+def static_branch(x, flag):
+    if flag:  # clean: flag is a static arg — retracing here is the point
+        return x * 2
+    return jnp.where(x > 0, x, -x)  # clean: the traced-branch idiom

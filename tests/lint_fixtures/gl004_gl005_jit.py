@@ -1,0 +1,48 @@
+"""GL004/GL005 fixtures — jit-in-loop and unhashable static args.
+
+Positives: jax.jit built in a loop body; list literal at a static
+position.
+Suppressed: one of each, inline disable.
+Negatives: hoisted construction; tuple at the static position.
+"""
+import jax
+
+
+def run(x, dims):
+    return x
+
+
+step = jax.jit(run, static_argnames=("dims",))
+
+
+def compile_per_batch(fns, batches):
+    outs = []
+    for fn, batch in zip(fns, batches):
+        fresh = jax.jit(fn)  # expect: GL004
+        outs.append(fresh(batch))
+    return outs
+
+
+def compile_per_batch_suppressed(fns, batches):
+    outs = []
+    for fn, batch in zip(fns, batches):
+        fresh = jax.jit(fn)  # graftlint: disable=GL004
+        outs.append(fresh(batch))
+    return outs
+
+
+def compile_once(fn, batches):
+    hoisted = jax.jit(fn)  # clean: built once, reused across iterations
+    return [hoisted(b) for b in batches]
+
+
+def call_unhashable(x):
+    return step(x, dims=[1, 2, 3])  # expect: GL005
+
+
+def call_unhashable_suppressed(x):
+    return step(x, dims=[1, 2])  # graftlint: disable=GL005
+
+
+def call_hashable(x):
+    return step(x, dims=(1, 2, 3))  # clean: tuples are hashable cache keys

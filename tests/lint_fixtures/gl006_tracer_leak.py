@@ -1,0 +1,43 @@
+"""GL006 fixtures — traced values escaping a jitted function.
+
+Positives: global declaration, self-attribute store, module-level
+container store — all inside jitted code.
+Suppressed: one container store, inline disable.
+Negative: a store into a function-local container (explicit carry).
+"""
+import jax
+
+_CACHE = {}
+
+
+@jax.jit
+def leak_global(x):
+    global _LAST  # expect: GL006
+    _LAST = x
+    return x + 1
+
+
+class LeakyModule:
+    @jax.jit
+    def forward(self, x):
+        self.peek = x + 1  # expect: GL006
+        return x * 2
+
+
+@jax.jit
+def leak_container(x):
+    _CACHE["x"] = x * 2  # expect: GL006
+    return x
+
+
+@jax.jit
+def leak_suppressed(x):
+    _CACHE["y"] = x  # graftlint: disable=GL006
+    return x
+
+
+@jax.jit
+def clean_carry(x):
+    acc = {}
+    acc["x"] = x  # clean: local container, dies with the trace
+    return acc["x"] + 1

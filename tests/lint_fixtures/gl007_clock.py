@@ -1,0 +1,36 @@
+"""GL007 fixtures — wall-clock calls in clock-disciplined paths.
+
+Positives: time.monotonic() in a scheduling decision; a
+``from time import``-aliased sleep call.
+Suppressed: one perf_counter call, inline disable.
+Negatives: the three allowlisted shapes — telemetry-timestamp binding,
+a ``*Clock`` class body, and an injectable default-arg *reference*.
+"""
+import time
+from time import sleep as wall_sleep
+
+
+def deadline_bad():
+    return time.monotonic() + 1.0  # expect: GL007
+
+
+def backoff_bad(delay_s):
+    wall_sleep(delay_s)  # expect: GL007
+
+
+def probe_suppressed():
+    return time.perf_counter()  # graftlint: disable=GL007
+
+
+def stamp_record(value):
+    ts = time.time()  # clean: epoch stamp on an exported record is data
+    return {"ts": ts, "value": value}
+
+
+def injectable(sleep=time.sleep):  # clean: a reference, not a call
+    return sleep
+
+
+class FakeClock:
+    def now(self):
+        return time.perf_counter()  # clean: *Clock IS the abstraction

@@ -1,0 +1,41 @@
+"""GL008/GL009 fixtures — metric naming and registry coherence.
+
+Positives: an off-convention family name; the same family registered
+as two instrument types; an orphan mingpt_* literal.
+Suppressed: one of each, inline disable.
+Negatives: the get-or-create idiom (same name, same type, twice), an
+f-string family with a conventional prefix, and a literal that matches
+a registered family.
+"""
+
+
+class _Reg:
+    """Stand-in with the MetricsRegistry registration surface."""
+
+    def counter(self, name, help=""):
+        return name
+
+    def gauge(self, name, help=""):
+        return name
+
+
+REG = _Reg()
+shard = 0
+
+BAD_NAME = REG.counter("serving_rejected_total")  # expect: GL008
+BAD_SUPPRESSED = REG.counter("tokens")  # graftlint: disable=GL008
+OK_NAME = REG.counter("mingpt_fixture_ok_total")
+
+FIRST = REG.counter("mingpt_fixture_conflict_total")
+SECOND = REG.gauge("mingpt_fixture_conflict_total")  # expect: GL009
+SUP_FIRST = REG.counter("mingpt_fixture_dup_total")
+SUP_SECOND = REG.gauge("mingpt_fixture_dup_total")  # graftlint: disable=GL009
+
+SHARED_A = REG.counter("mingpt_fixture_shared_total")
+SHARED_B = REG.counter("mingpt_fixture_shared_total")  # clean: get-or-create
+
+PER_SHARD = REG.gauge(f"mingpt_fixture_shard{shard}_depth")  # clean prefix
+
+ORPHAN = "mingpt_fixture_missing_total"  # expect: GL009
+ORPHAN_SUPPRESSED = "mingpt_fixture_ghost_total"  # graftlint: disable=GL009
+KNOWN = "mingpt_fixture_ok_total"  # clean: matches a registered family

@@ -1,0 +1,23 @@
+"""GL010 fixtures — bare print in library code.
+
+Positives: print() and sys.stderr.write().
+Suppressed: one print, inline disable.
+Negative: routing through telemetry.spans.log_event.
+"""
+import sys
+
+
+def report_bad(msg):
+    print(msg)  # expect: GL010
+
+
+def report_stderr(msg):
+    sys.stderr.write(msg + "\n")  # expect: GL010
+
+
+def report_suppressed(msg):
+    print(msg)  # graftlint: disable=GL010
+
+
+def report_good(msg):
+    log_event(msg)  # clean: process-prefixed, mirrored to the span ring

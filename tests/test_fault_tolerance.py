@@ -11,6 +11,7 @@ uninterrupted run; a digest-mismatched blob is never loaded.
 import errno
 import os
 import signal
+import time
 
 import fsspec
 import numpy as np
@@ -280,6 +281,24 @@ def test_injected_missing_read_is_fresh_start(tmp_path, faulty_fs):
     assert ckpt.load_snapshot(
         f"faulty://{tmp_path}/absent.msgpack", PARAMS_LIKE,
         retry=NO_WAIT) is None
+
+
+def test_delay_faults_use_injected_sleep(tmp_path, faulty_fs):
+    """Delay faults go through the injectable sleep (the
+    ``RetryPolicy.sleep`` idiom): a fake sleep makes them instantaneous
+    and assertable, so the suite stays wall-sleep-free."""
+    slept = []
+    faulty_fs.sleep = slept.append
+    try:
+        path = f"faulty://{tmp_path}/snap.msgpack"
+        faulty_fs.set_faults("write:nth=1:mode=delay:delay=7.5")
+        ckpt.save_snapshot(path, tiny_snapshot(step=2), retry=NO_WAIT)
+        faulty_fs.set_faults("read:nth=1:mode=delay:delay=2.5")
+        snap = ckpt.load_snapshot(path, PARAMS_LIKE, OPT_LIKE, retry=NO_WAIT)
+        assert snap.step == 2
+        assert slept == [7.5, 2.5]
+    finally:
+        faulty_fs.sleep = time.sleep
 
 
 # ---------------------------------------------------------------------------
