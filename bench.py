@@ -302,6 +302,42 @@ def profile_inner(outdir: str) -> int:
     return 0
 
 
+def _attach_multichip(record: dict) -> None:
+    """ZeRO dp update-sharding extra (ISSUE 9): per-device param/opt-state
+    bytes and update-phase time, replicated vs ``zero_dp``, measured on a
+    hermetic virtual-CPU dp mesh in a bounded subprocess. Never fatal, and
+    independent of the accelerator probe (the mesh is host-platform by
+    construction), so it also lands on cpu_fallback records."""
+    try:
+        if os.environ.get("BENCH_MULTICHIP", "1") == "0":
+            raise RuntimeError("disabled via BENCH_MULTICHIP=0")
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--multichip-inner"],
+            capture_output=True, text=True, env=env,
+            timeout=_env_num("BENCH_MULTICHIP_TIMEOUT_S", 600, int),
+        )
+        sys.stderr.write(proc.stderr)
+        for line in reversed(proc.stdout.strip().splitlines()):
+            try:
+                record["multichip"] = json.loads(line)
+                return
+            except ValueError:
+                continue
+        raise RuntimeError(f"rc={proc.returncode}, no JSON line")
+    except Exception as e:  # noqa: BLE001 — optional extra, never fatal
+        print(f"multichip extra skipped: {e}", file=sys.stderr)
+
+
 def main() -> int:
     probe = _probe_backend_with_retry()
     if "error" in probe:
@@ -312,6 +348,7 @@ def main() -> int:
         record = _cpu_fallback_record(probe["error"])
         if record is None:
             record = _error_record(probe["error"])
+        _attach_multichip(record)
         print(json.dumps(record))
         return 0
     if "--profile" in sys.argv:
@@ -392,6 +429,7 @@ def main() -> int:
         tail = (proc.stderr or "").strip().splitlines()[-3:]
         record = _error_record(
             f"bench rc={proc.returncode}, no JSON: " + " | ".join(tail))
+    _attach_multichip(record)
     print(json.dumps(record))
     return 0
 
@@ -1057,9 +1095,139 @@ def serving_probe() -> dict:
     }
 
 
+def multichip_inner() -> int:
+    """Runs under the hermetic virtual-CPU env _attach_multichip sets up:
+    a dp=4 mesh, one model/optimizer, and the trainer's exact update
+    phase jitted twice — replicated and ``zero_dp`` — reporting per-device
+    param/opt-state bytes and update-phase wall time for both. The bytes
+    are layout facts (addressable-shard sums), valid on any backend; the
+    update-phase ms is a CPU-relative comparison of the two programs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from mingpt_distributed_tpu.config import (
+        GPTConfig, MeshConfig, OptimizerConfig,
+    )
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.parallel import mesh as mesh_lib
+    from mingpt_distributed_tpu.parallel import zero as zero_lib
+    from mingpt_distributed_tpu.parallel.mesh import state_shardings
+    from mingpt_distributed_tpu.training.optimizer import make_optimizer
+
+    dp = 4
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(dp=dp), devices=jax.devices()[:dp]
+    )
+    # big enough that moment bytes dominate scalar overheads, small enough
+    # to stay seconds on CPU: ~3M params -> ~24 MB of fp32 Adam moments
+    cfg = GPTConfig.make(
+        n_layer=4, n_head=4, n_embd=256, vocab_size=512, block_size=64,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    optimizer = make_optimizer(OptimizerConfig(), grad_norm_clip=1.0)
+    params_shape = jax.eval_shape(lambda: gpt.init(jax.random.key(0), cfg))
+    plan = zero_lib.make_plan(mesh, params_shape)
+
+    def measure(zero_plan):
+        def init_state():
+            params = gpt.init(jax.random.key(0), cfg)
+            target = (
+                zero_lib.update_view(params, zero_plan)
+                if zero_plan is not None else params
+            )
+            return {
+                "params": params,
+                "opt_state": optimizer.init(target),
+                "step": jnp.asarray(0, dtype=jnp.int32),
+            }
+
+        shardings = state_shardings(
+            mesh, jax.eval_shape(init_state), zero_plan=zero_plan
+        )
+        state = jax.jit(init_state, out_shardings=shardings)()
+
+        def update_only(state, grads):
+            # the trainer's update phase verbatim (make_train_step minus
+            # forward/backward), so the timed program is the real one
+            if zero_plan is not None:
+                gview = zero_lib.constrain(
+                    zero_lib.update_view(grads, zero_plan), zero_plan
+                )
+                pview = zero_lib.constrain(
+                    zero_lib.update_view(state["params"], zero_plan),
+                    zero_plan,
+                )
+                updates, new_opt = optimizer.update(
+                    gview, state["opt_state"], pview
+                )
+                new_params = zero_lib.from_view(
+                    optax.apply_updates(pview, updates), zero_plan
+                )
+            else:
+                updates, new_opt = optimizer.update(
+                    grads, state["opt_state"], state["params"]
+                )
+                new_params = optax.apply_updates(state["params"], updates)
+            return {
+                "params": new_params, "opt_state": new_opt,
+                "step": state["step"] + 1,
+            }
+
+        param_shardings = shardings["params"]
+        grads = jax.jit(
+            lambda p: jax.tree.map(lambda a: 1e-3 * a, p),
+            out_shardings=param_shardings,
+        )(state["params"])
+        fn = jax.jit(
+            update_only,
+            in_shardings=(shardings, param_shardings),
+            out_shardings=shardings,
+        )
+        for _ in range(2):
+            state = fn(state, grads)
+        jax.block_until_ready(state)
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            state = fn(state, grads)
+        jax.block_until_ready(state)
+        dt = (time.perf_counter() - t0) / n
+        assert np.isfinite(
+            float(jax.device_get(jax.tree.leaves(state["params"])[0]).ravel()[0])
+        )
+        return {
+            "param_bytes_per_device": zero_lib.per_device_bytes(
+                state["params"]
+            ),
+            "opt_state_bytes_per_device": zero_lib.per_device_bytes(
+                state["opt_state"]
+            ),
+            "update_ms": round(dt * 1e3, 2),
+        }
+
+    replicated = measure(None)
+    sharded = measure(plan)
+    print(json.dumps({
+        "mesh": {"dp": dp},
+        "n_devices": dp,
+        "model": {"n_layer": cfg.n_layer, "n_embd": cfg.n_embd},
+        "replicated": replicated,
+        "zero_dp": sharded,
+        "opt_bytes_ratio": round(
+            sharded["opt_state_bytes_per_device"]
+            / max(replicated["opt_state_bytes_per_device"], 1), 4
+        ),
+    }), flush=True)
+    return 0
+
+
 if __name__ == "__main__":
     if "--inner" in sys.argv:
         sys.exit(inner())
     if "--profile-inner" in sys.argv:
         sys.exit(profile_inner(sys.argv[sys.argv.index("--profile-inner") + 1]))
+    if "--multichip-inner" in sys.argv:
+        sys.exit(multichip_inner())
     sys.exit(main())

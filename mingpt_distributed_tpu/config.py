@@ -412,6 +412,14 @@ class TrainerConfig:
     # (one lax.scan inside the same jitted step): activation memory scales
     # with batch_size/grad_accum_steps, semantics stay the full batch.
     grad_accum_steps: int = 1
+    # ZeRO-style cross-replica weight-update sharding over the dp axis
+    # (ISSUE 9, arXiv 2004.13336): reduce-scatter grads, run the optimizer
+    # on the local 1/dp shard, allgather params; Adam moments are
+    # physically 1/dp per device. Loss/param parity with the replicated
+    # update (train.py --selftest-zero). No-op at dp=1; requires the
+    # msgpack checkpoint backend (canonical-layout snapshots reshard to
+    # any dp extent on restore).
+    zero_dp: bool = False
     prefetch: int = 2  # background batch-prefetch depth; 0 disables
     # debug aids (SURVEY §5.2 — the reference shipped a real checkpoint race
     # and had no sanitizers): jax_debug_nans traps the first NaN/Inf inside

@@ -202,10 +202,41 @@ def param_specs(params_shape: Any) -> Any:
     return jax.tree_util.tree_map_with_path(_spec_for, params_shape)
 
 
-def shard_by_rule(mesh: Mesh, shape: Sequence[int], spec: P) -> NamedSharding:
+# Leaf names whose rule has already been observed downgrading on this
+# process — each (param, axes) surprise is logged/counted exactly once,
+# not once per mesh rebuild or per moment tree that shares the name.
+_DOWNGRADES_SEEN: set = set()
+
+
+def _note_downgrade(name: str, axes, size: int, n: int) -> None:
+    key = (name, axes)
+    if key in _DOWNGRADES_SEEN:
+        return
+    _DOWNGRADES_SEEN.add(key)
+    from mingpt_distributed_tpu import telemetry
+
+    telemetry.get_registry().counter(
+        "mingpt_train_sharding_downgrades_total",
+        help="Parameter-sharding rules silently downgraded to replication "
+             "because the mesh axis extent does not divide the dimension.",
+        labels=("param",),
+    ).labels(param=name).inc()
+    telemetry.log_event(
+        f"sharding downgrade: {name} dim of size {size} not divisible by "
+        f"mesh extent {n} of axes {axes!r} — replicating that dimension",
+        param=name,
+    )
+
+
+def shard_by_rule(
+    mesh: Mesh, shape: Sequence[int], spec: P, name: Optional[str] = None
+) -> NamedSharding:
     """NamedSharding for one array, downgrading (replicating) any spec axis
     whose mesh extent doesn't divide the dimension — tiny models on big
-    meshes shard what they can instead of failing."""
+    meshes shard what they can instead of failing. When ``name`` is given,
+    each downgrade is logged once and counted in
+    ``mingpt_train_sharding_downgrades_total{param}`` so surprise
+    replication shows up in scrapes instead of only in the memory bill."""
     fixed = []
     for size, axes in zip(shape, spec):
         if axes is None:
@@ -213,33 +244,54 @@ def shard_by_rule(mesh: Mesh, shape: Sequence[int], spec: P) -> NamedSharding:
             continue
         ax_tuple = axes if isinstance(axes, tuple) else (axes,)
         n = math.prod(mesh.shape[a] for a in ax_tuple)
-        fixed.append(axes if size % n == 0 else None)
+        if size % n == 0:
+            fixed.append(axes)
+        else:
+            if name is not None:
+                _note_downgrade(name, axes, size, n)
+            fixed.append(None)
     return NamedSharding(mesh, P(*fixed))
 
 
 def param_shardings(mesh: Mesh, params_shape: Any) -> Any:
     """NamedSharding pytree for model params (divisibility-validated)."""
     return jax.tree_util.tree_map_with_path(
-        lambda path, leaf: shard_by_rule(mesh, leaf.shape, _spec_for(path, leaf)),
+        lambda path, leaf: shard_by_rule(
+            mesh, leaf.shape, _spec_for(path, leaf), name=leaf_name(path)
+        ),
         params_shape,
     )
 
 
-def state_shardings(mesh: Mesh, state_shape: Any) -> Any:
+def state_shardings(mesh: Mesh, state_shape: Any, zero_plan=None) -> Any:
     """NamedShardings for a whole TrainState-like pytree.
 
     Optimizer moments (mu/nu) mirror the params pytree leaf-for-leaf with the
     same leaf names, so PARAM_RULES applies to them unchanged — ZeRO-style
     sharded optimizer state for free (BASELINE config #4). Scalars and
     unrecognised leaves replicate.
-    """
+
+    With a ``zero_plan`` (parallel/zero.py), opt-state moment leaves get the
+    plan's dp-sharded *update-view* spec instead, so Adam's mu/nu are
+    physically 1/dp per device. Only leaves under the ``opt_state`` key
+    whose shape matches the plan's view shape are re-routed — the params
+    themselves keep their canonical sharding (they are gathered back after
+    every update)."""
 
     def rule(path, leaf):
         if getattr(leaf, "ndim", 0) == 0:
             return NamedSharding(mesh, P())
         name = leaf_name(path)
+        if (
+            zero_plan is not None
+            and path
+            and getattr(path[0], "key", None) == "opt_state"
+        ):
+            lp = zero_plan.by_name.get(name)
+            if lp is not None and tuple(leaf.shape) == tuple(lp.view_shape):
+                return NamedSharding(mesh, lp.spec)
         if name in PARAM_RULES:
-            return shard_by_rule(mesh, leaf.shape, PARAM_RULES[name])
+            return shard_by_rule(mesh, leaf.shape, PARAM_RULES[name], name=name)
         return NamedSharding(mesh, P())
 
     return jax.tree_util.tree_map_with_path(rule, state_shape)

@@ -48,6 +48,11 @@ from mingpt_distributed_tpu.training.durability import (
 )
 
 SNAPSHOT_VERSION = 1
+#: payload version of one shard of a sharded snapshot (ISSUE 9): same
+#: schema as v1 plus ``shard``/``n_shards`` framing; every leaf is
+#: flattened and split into n_shards contiguous chunks, meta fields
+#: (prng/data_state/config) ride in shard 0 only.
+SHARDED_SNAPSHOT_VERSION = 2
 DEFAULT_SNAPSHOT_PATH = "gpt_snapshot.msgpack"  # reference default: gpt_snapshot.pt
 DEFAULT_KEEP = 3  # checkpoints retained in the manifest (keep-last-K)
 
@@ -71,37 +76,100 @@ def _to_host(tree: Any) -> Any:
     return jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
 
 
+def _chunk_state(sd: Any, i: int, n: int) -> Any:
+    """Shard ``i``'s slice of a state dict: every leaf flattened and
+    contiguously split into ``n`` near-equal chunks (0-d leaves land
+    wholly in shard 0; np.array_split pads nothing)."""
+    if isinstance(sd, dict):
+        return {k: _chunk_state(v, i, n) for k, v in sd.items()}
+    return np.array_split(np.asarray(sd).reshape(-1), n)[i]
+
+
+def _assemble_state(skel_sd: Any, shard_sds: list, label: str) -> Any:
+    """Inverse of ``_chunk_state``: concatenate every leaf's chunks across
+    the shard payloads and reshape against the skeleton state dict."""
+    if isinstance(skel_sd, dict):
+        try:
+            return {
+                k: _assemble_state(skel_sd[k], [s[k] for s in shard_sds], label)
+                for k in skel_sd
+            }
+        except KeyError as e:
+            raise ValueError(
+                f"sharded snapshot {label} is missing key {e} expected by "
+                f"the current config — refusing to restore"
+            ) from None
+    flat = np.concatenate([np.asarray(s).reshape(-1) for s in shard_sds])
+    return flat.reshape(tuple(np.shape(skel_sd)))
+
+
 def save_snapshot(
     path: str,
     snap: Snapshot,
     keep: int = DEFAULT_KEEP,
     retry: Optional[RetryPolicy] = None,
+    shards: int = 1,
 ) -> None:
     """Serialise and durably commit. Call only from the single writer
     (process 0).
 
-    The write protocol (durability.commit_blob): the blob lands at a
-    step-suffixed key nothing references yet (local keys additionally use
-    tmp+rename, the reference's atomicity, now with a digest), then the
-    manifest PUT commits it. A crash or injected fault anywhere in between
-    leaves the previous manifest — and every checkpoint it points at —
-    fully intact. Transient fsspec errors retry with backoff + jitter.
+    The write protocol (durability.commit_blob/commit_shards): the data
+    objects land at step-suffixed keys nothing references yet (local keys
+    additionally use tmp+rename, the reference's atomicity, now with a
+    digest), then the manifest PUT commits them as a unit. A crash or
+    injected fault anywhere in between leaves the previous manifest — and
+    every checkpoint it points at — fully intact. Transient fsspec errors
+    retry with backoff + jitter.
+
+    ``shards > 1`` (manifest schema v2) splits the state into that many
+    data objects with per-shard digests — ZeRO runs pass their dp extent
+    so write amplification tracks per-host state. The *contents* are
+    layout-independent (each leaf contiguously chunked), so any shard
+    count restores against any other; the shard count is a property of
+    the write, not of the checkpoint.
     """
-    payload = {
-        "version": SNAPSHOT_VERSION,
-        "step": snap.step,
-        "epoch": snap.epoch,
-        "prng": None if snap.prng is None else np.asarray(snap.prng),
-        "data_state": json.dumps(snap.data_state),
-        "config": json.dumps(snap.config),
-        "state": {
-            "params": _to_host(snap.params),
-            "opt_state": _to_host(snap.opt_state),
-        },
+    state = {
+        "params": _to_host(snap.params),
+        "opt_state": _to_host(snap.opt_state),
     }
-    blob = serialization.to_bytes(payload)
-    durability.commit_blob(
-        path, blob, step=snap.step, epoch=snap.epoch, keep=keep, policy=retry
+    if shards <= 1:
+        payload = {
+            "version": SNAPSHOT_VERSION,
+            "step": snap.step,
+            "epoch": snap.epoch,
+            "prng": None if snap.prng is None else np.asarray(snap.prng),
+            "data_state": json.dumps(snap.data_state),
+            "config": json.dumps(snap.config),
+            "state": state,
+        }
+        blob = serialization.to_bytes(payload)
+        durability.commit_blob(
+            path, blob, step=snap.step, epoch=snap.epoch, keep=keep,
+            policy=retry,
+        )
+        return
+    state_sd = serialization.to_state_dict(state)
+    blobs = []
+    for i in range(shards):
+        payload = {
+            "version": SHARDED_SNAPSHOT_VERSION,
+            "shard": i,
+            "n_shards": shards,
+            "step": snap.step,
+            "epoch": snap.epoch,
+            # meta rides in shard 0 only — it is tiny and restoring it
+            # twice would be ambiguity, not redundancy
+            "prng": (
+                np.asarray(snap.prng)
+                if i == 0 and snap.prng is not None else None
+            ),
+            "data_state": json.dumps(snap.data_state) if i == 0 else "",
+            "config": json.dumps(snap.config) if i == 0 else "",
+            "state": _chunk_state(state_sd, i, shards),
+        }
+        blobs.append(serialization.to_bytes(payload))
+    durability.commit_shards(
+        path, blobs, step=snap.step, epoch=snap.epoch, keep=keep, policy=retry
     )
 
 
@@ -132,8 +200,13 @@ def load_snapshot(
     """
     manifest = durability.load_manifest(path, retry)
     if manifest is not None and manifest.entries:
-        blob, entry = durability.read_verified(path, manifest, retry)
-        payload = _restore_payload(blob, source=entry.key)
+        blobs, entry = durability.read_verified_shards(path, manifest, retry)
+        if entry.shards is None:
+            payload = _restore_payload(blobs[0], source=entry.key)
+        else:
+            payload = _restore_sharded(
+                blobs, entry, params_like, opt_state_like
+            )
     else:
         # legacy pre-manifest layout: one blob at the bare path
         try:
@@ -181,7 +254,9 @@ def _owned(tree: Any) -> Any:
     return jax.tree.map(np.array, tree)
 
 
-def _restore_payload(blob: bytes, source: str) -> dict:
+def _restore_payload(
+    blob: bytes, source: str, expected: int = SNAPSHOT_VERSION
+) -> dict:
     """msgpack bytes -> payload dict, with version gate and a corruption
     error that names the offending object."""
     try:
@@ -190,11 +265,62 @@ def _restore_payload(blob: bytes, source: str) -> dict:
         raise SnapshotIntegrityError(
             f"snapshot blob {source} is corrupt (msgpack decode failed): {e}"
         ) from e
-    if payload["version"] != SNAPSHOT_VERSION:
+    if payload["version"] != expected:
         raise ValueError(
-            f"snapshot version {payload['version']} != {SNAPSHOT_VERSION}"
+            f"snapshot version {payload['version']} != {expected}"
         )
     return payload
+
+
+def _restore_sharded(
+    blobs: list, entry, params_like: Any, opt_state_like: Any
+) -> dict:
+    """Shard payloads (already digest-verified) -> one v1-shaped payload
+    with fully assembled state sections. Works for ANY saved shard count:
+    the chunking is layout-independent, so this is where a dp=4 checkpoint
+    reshards onto a dp=2 or dp=1 run."""
+    payloads = [
+        _restore_payload(
+            blob, source=entry.shards[i].key,
+            expected=SHARDED_SNAPSHOT_VERSION,
+        )
+        for i, blob in enumerate(blobs)
+    ]
+    payloads.sort(key=lambda p: int(p["shard"]))
+    n = len(payloads)
+    if [int(p["shard"]) for p in payloads] != list(range(n)) or any(
+        int(p["n_shards"]) != n for p in payloads
+    ):
+        raise SnapshotIntegrityError(
+            f"sharded snapshot at step {entry.step} has inconsistent shard "
+            f"framing: got shards "
+            f"{[(int(p['shard']), int(p['n_shards'])) for p in payloads]}"
+        )
+    head = payloads[0]
+    state_sds = [p["state"] for p in payloads]
+    params_skel = serialization.to_state_dict(_abstract_to_zeros(params_like))
+    state = {
+        "params": _assemble_state(
+            params_skel, [s["params"] for s in state_sds], "params"
+        ),
+        "opt_state": None,
+    }
+    if opt_state_like is not None:
+        opt_skel = serialization.to_state_dict(
+            _abstract_to_zeros(opt_state_like)
+        )
+        state["opt_state"] = _assemble_state(
+            opt_skel, [s["opt_state"] for s in state_sds], "opt_state"
+        )
+    return {
+        "version": SNAPSHOT_VERSION,
+        "step": head["step"],
+        "epoch": head["epoch"],
+        "prng": head["prng"],
+        "data_state": head["data_state"],
+        "config": head["config"],
+        "state": state,
+    }
 
 
 def _check_shapes(expected: Any, restored: Any, label: str) -> None:

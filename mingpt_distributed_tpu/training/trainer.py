@@ -51,6 +51,7 @@ from mingpt_distributed_tpu.data.char_dataset import (
 )
 from mingpt_distributed_tpu.models import gpt
 from mingpt_distributed_tpu.parallel import mesh as mesh_lib
+from mingpt_distributed_tpu.parallel import zero as zero_lib
 from mingpt_distributed_tpu.training import checkpoint as ckpt_lib
 from mingpt_distributed_tpu.training.durability import RetryPolicy
 from mingpt_distributed_tpu.training.metrics import MetricsLogger
@@ -75,6 +76,7 @@ def make_train_step(
     mesh=None,
     grad_accum: int = 1,
     lr_fn=None,  # step -> learning rate, for the metrics line (SURVEY §5.5)
+    zero_plan=None,  # parallel/zero.py ZeroPlan: dp-sharded weight update
 ):
     """forward+backward+update as one pure function of (state, batch, rng).
 
@@ -85,6 +87,15 @@ def make_train_step(
     Micro-batch losses/grads are averaged with equal weight (the standard
     mean-of-means convention; exact whenever ignore_index masking is evenly
     distributed, and exactly equal to grad_accum=1 when no -1 targets).
+
+    With a ``zero_plan`` the update phase runs ZeRO weight-update sharding
+    (arXiv 2004.13336): grads are reduce-scattered over dp (the sharding
+    constraint on the grads' update view turns the dp all-reduce into
+    all-reduce+shard, which GSPMD fuses), clip/Adam/decay/lr run on the
+    local 1/dp shard only, and the updated params are allgathered back to
+    their canonical sharding by the output constraint. Composes with
+    ``grad_accum`` unchanged — accumulation happens before the sharded
+    update phase.
     """
 
     def loss_and_grads(params, x, y, rng, deterministic):
@@ -143,11 +154,37 @@ def make_train_step(
                 state["params"], x, y, rng, deterministic
             )
 
-        updates, new_opt = optimizer.update(
-            grads, state["opt_state"], state["params"]
-        )
-        new_params = optax.apply_updates(state["params"], updates)
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if zero_plan is not None:
+            # ZeRO update phase: shard grads+params into the update view,
+            # step the optimizer on the local 1/dp shard, gather back.
+            gview = zero_lib.constrain(
+                zero_lib.update_view(grads, zero_plan), zero_plan
+            )
+            pview = zero_lib.constrain(
+                zero_lib.update_view(state["params"], zero_plan), zero_plan
+            )
+            updates, new_opt = optimizer.update(
+                gview, state["opt_state"], pview
+            )
+            # allgather happens here: from_view restores canonical shapes
+            # and the step's out_shardings pin the canonical param layout
+            new_params = zero_lib.from_view(
+                optax.apply_updates(pview, updates), zero_plan
+            )
+        else:
+            updates, new_opt = optimizer.update(
+                grads, state["opt_state"], state["params"]
+            )
+            new_params = optax.apply_updates(state["params"], updates)
+        metrics = {
+            "loss": loss,
+            # pre-clip gradient norm (global: GSPMD psums sharded leaves)
+            "grad_norm": optax.global_norm(grads),
+            # post-clip/applied update norm — grad_norm alone can't show
+            # whether clipping actually bit (flat-mode pad slots are zero
+            # and contribute nothing)
+            "update_norm": optax.global_norm(updates),
+        }
         if lr_fn is not None:
             metrics["lr"] = lr_fn(state["step"])
         return (
@@ -293,12 +330,36 @@ class GPTTrainer:
                 "sharded saves run synchronously (collective write). Set "
                 "async_save=False, or use a .msgpack snapshot_path."
             )
+        # --- ZeRO weight-update sharding over dp (opt-in, ISSUE 9) --------
+        # The plan is static per (mesh, model): dp<=1 means the view would
+        # be the identity, so the plan stays None and the step compiles the
+        # exact replicated baseline program.
+        self.zero_plan = None
+        if config.zero_dp:
+            if self.ckpt_backend == "orbax":
+                # refuse rather than save the dp-local update view: the
+                # Orbax backend writes device shards as-is, so a zero_dp
+                # checkpoint would bake in this run's dp extent (and flat
+                # padding) instead of the canonical resharding layout.
+                raise ConfigError(
+                    "zero_dp=True requires the msgpack backend (a "
+                    ".msgpack snapshot_path): its save path canonicalises "
+                    "the dp-sharded optimizer state so checkpoints restore "
+                    "at any dp extent. Orbax would persist the view layout."
+                )
+            if int(self.mesh.shape["dp"]) > 1:
+                params_shape = jax.eval_shape(
+                    lambda: gpt.init(jax.random.key(config.seed), gpt_config)
+                )
+                self.zero_plan = zero_lib.make_plan(self.mesh, params_shape)
         self.base_rng = jax.random.key(config.seed)
 
         # --- abstract state + shardings, then materialise on-mesh ---------
         init_fn = lambda: self._fresh_state(jax.random.key(config.seed))
         state_shape = jax.eval_shape(init_fn)
-        self.shardings = state_shardings(self.mesh, state_shape)
+        self.shardings = state_shardings(
+            self.mesh, state_shape, zero_plan=self.zero_plan
+        )
         self.batch_sharding = mesh_lib.batch_sharding(self.mesh)
         self.repl = NamedSharding(self.mesh, P())
 
@@ -313,12 +374,29 @@ class GPTTrainer:
                 retry=self._retry,
             )
         else:
+            # Checkpoints store the opt state in CANONICAL layout (original
+            # leaf shapes, no dp padding) regardless of zero_dp — restore
+            # into the canonical skeleton, then re-view for THIS mesh's
+            # plan. That is the whole reshard-on-restore mechanism: a
+            # snapshot written at dp=4 localises cleanly at dp=2 or dp=1.
+            opt_like = state_shape["opt_state"]
+            if self.zero_plan is not None:
+                opt_like = zero_lib.canonical_opt_shape(
+                    opt_like, self.zero_plan
+                )
             restored = ckpt_lib.load_snapshot(
                 self.snapshot_path,
                 state_shape["params"],
-                state_shape["opt_state"],
+                opt_like,
                 retry=self._retry,
             )
+            if restored is not None and self.zero_plan is not None:
+                restored = dataclasses.replace(
+                    restored,
+                    opt_state=zero_lib.localize_opt_state(
+                        restored.opt_state, self.zero_plan
+                    ),
+                )
         if restored is None:
             if self.is_writer:
                 log_event("Snapshot not found. Training model from scratch",
@@ -374,7 +452,8 @@ class GPTTrainer:
         self._train_step = jax.jit(
             make_train_step(gpt_config, self.optimizer, self.mesh,
                             grad_accum=config.grad_accum_steps,
-                            lr_fn=self._lr_fn),
+                            lr_fn=self._lr_fn,
+                            zero_plan=self.zero_plan),
             in_shardings=(self.shardings, (self.batch_sharding,) * 2, self.repl),
             out_shardings=(self.shardings, self.repl),
             donate_argnums=(0,),
@@ -402,9 +481,19 @@ class GPTTrainer:
     # ------------------------------------------------------------------
     def _fresh_state(self, rng) -> TrainState:
         params = gpt.init(rng, self.gpt_config)
+        if self.zero_plan is not None:
+            # moments live in the update view (flat-mode leaves padded +
+            # flattened) so they can be physically 1/dp under the plan's
+            # shardings; Adam init on pad zeros is zeros, so the view is
+            # exactly the localised canonical state
+            opt_state = self.optimizer.init(
+                zero_lib.update_view(params, self.zero_plan)
+            )
+        else:
+            opt_state = self.optimizer.init(params)
         return {
             "params": params,
-            "opt_state": self.optimizer.init(params),
+            "opt_state": opt_state,
             "step": jnp.asarray(0, dtype=jnp.int32),
         }
 
@@ -712,6 +801,15 @@ class GPTTrainer:
             else:
                 params = self.state["params"]
                 opt_state = self.state["opt_state"]
+            if self.zero_plan is not None:
+                # snapshots always store the CANONICAL layout (original
+                # shapes, no dp padding) so they restore at any dp extent
+                opt_state = zero_lib.canonical_opt_state(
+                    jax.device_get(opt_state), self.zero_plan
+                )
+            # shard the checkpoint data objects with the update shards:
+            # per-shard writes/digests keep save cost ~per-host-state
+            n_shards = self.zero_plan.dp if self.zero_plan is not None else 1
             if not self.is_writer:
                 return
             if self.config.async_save:
@@ -733,7 +831,8 @@ class GPTTrainer:
                 def _write():
                     try:
                         ckpt_lib.save_snapshot(
-                            path, host_snap, keep=keep, retry=retry
+                            path, host_snap, keep=keep, retry=retry,
+                            shards=n_shards,
                         )
                         log_event(
                             f"Snapshot saved to {path} "
@@ -754,6 +853,7 @@ class GPTTrainer:
                     ),
                     keep=self.config.keep_snapshots,
                     retry=self._retry,
+                    shards=n_shards,
                 )
         if self.is_writer:
             log_event(

@@ -28,6 +28,16 @@ cd "$(dirname "$0")"
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m mingpt_distributed_tpu.analysis
 
+# ZeRO parity gate (ISSUE 9): on a dp=2 host-platform mesh, training with
+# zero_dp (reduce-scatter grads -> 1/dp-local clip/Adam/decay -> allgather
+# params) must reproduce the replicated baseline's losses and parameters
+# within fp32 tolerance at grad_accum 1 AND 2, with optimizer moments
+# physically ~1/dp per device. The inner subprocess pins its own hermetic
+# env; XLA_FLAGS here only covers the outer dispatch.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+    python train.py --selftest-zero
+
 has_m=0
 for a in "$@"; do
   [[ "$a" == "-m" ]] && has_m=1

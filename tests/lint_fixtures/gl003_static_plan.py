@@ -1,0 +1,44 @@
+"""GL003 fixture — the static-plan idiom (parallel/zero.py, ISSUE 9).
+
+The ZeRO update view branches per leaf on FROZEN dataclass fields
+(``mode``/``pad``) of a plan built before tracing: those are fixed
+python values, so the jitted program contains no traced branching and
+the branch is clean. Positives: the same-shaped branch taken on a
+traced value instead.
+"""
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class _LeafPlan:
+    mode: str
+    pad: int
+
+
+_PLAN = _LeafPlan(mode="flat", pad=3)
+
+
+@jax.jit
+def pads_by_static_plan(x):
+    flat = jnp.reshape(x, (-1,))
+    if _PLAN.pad:  # clean: plan fields are fixed python ints at trace time
+        flat = jnp.pad(flat, (0, _PLAN.pad))
+    return flat
+
+
+@jax.jit
+def branches_on_traced_leaf(x):
+    if x > 0:  # expect: GL003
+        return x
+    return -x
+
+
+@jax.jit
+def pad_amount_from_tracer(x):
+    pad = x + 0  # a traced value standing in for a miscomputed pad
+    if pad:  # graftlint: disable=GL003
+        x = x + 1
+    return x

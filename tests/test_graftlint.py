@@ -230,6 +230,23 @@ def test_select_unknown_rule_is_usage_error():
     assert lint_main(["--select", "GL999", "."]) == 2
 
 
+def test_zero_module_clean_under_jit_hazard_rules():
+    """ISSUE 9: parallel/zero.py's update-view transforms run inside the
+    jitted train step, so the module must stay clean under the jit-hazard
+    rules (GL001-GL006) outright — no suppressions, no baseline entries.
+    The approved pattern (branching on frozen LeafPlan fields, which are
+    python-static at trace time) is documented by the
+    gl003_static_plan.py fixture."""
+    path = os.path.join(
+        REPO, "mingpt_distributed_tpu", "parallel", "zero.py")
+    res = Engine(
+        select=["GL001", "GL002", "GL003", "GL004", "GL005", "GL006"],
+        root=REPO,
+    ).run([path])
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out

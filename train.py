@@ -89,6 +89,153 @@ def selftest_faults() -> int:
     return rc
 
 
+def selftest_zero() -> int:
+    """ZeRO weight-update-sharding parity gate (ISSUE 9): on a dp=2
+    host-platform mesh, the sharded update (reduce-scatter grads ->
+    local 1/dp clip/Adam/decay/lr -> allgather params) must match the
+    replicated baseline's losses and parameters within fp32 tolerance,
+    at grad_accum=1 AND grad_accum=2, and the optimizer moments must be
+    physically ~1/dp per device.
+
+    Hermetic by construction (the dryrun_multichip recipe): the work runs
+    in a subprocess whose env forces ``JAX_PLATFORMS=cpu`` with 8 virtual
+    host devices, so it cannot dial ambient TPU plugins regardless of
+    what the calling process initialised."""
+    import os
+    import subprocess
+
+    if os.environ.get("_MINGPT_SELFTEST_ZERO_INNER") != "1":
+        env = dict(os.environ)
+        env["_MINGPT_SELFTEST_ZERO_INNER"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = ""
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = [
+            f for f in env.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        flags.append("--xla_force_host_platform_device_count=8")
+        env["XLA_FLAGS"] = " ".join(flags)
+        here = os.path.dirname(os.path.abspath(__file__))
+        return subprocess.run(
+            [sys.executable, os.path.join(here, "train.py"),
+             "--selftest-zero"],
+            env=env, cwd=here,
+        ).returncode
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from mingpt_distributed_tpu.config import (
+        GPTConfig, MeshConfig, OptimizerConfig,
+    )
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.parallel import mesh as mesh_lib
+    from mingpt_distributed_tpu.parallel import zero as zero_lib
+    from mingpt_distributed_tpu.training.optimizer import (
+        lr_schedule, make_optimizer,
+    )
+    from mingpt_distributed_tpu.training.trainer import (
+        make_train_step, state_shardings,
+    )
+
+    rc = 0
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=4, n_embd=64, vocab_size=256, block_size=32,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    opt_cfg = OptimizerConfig()
+    optimizer = make_optimizer(
+        opt_cfg, grad_norm_clip=1.0, schedule=lr_schedule(opt_cfg)
+    )
+    mesh = mesh_lib.make_mesh(MeshConfig(dp=2), devices=jax.devices()[:2])
+    batch_sharding = mesh_lib.batch_sharding(mesh)
+    repl = NamedSharding(mesh, P())
+
+    params_shape = jax.eval_shape(lambda: gpt.init(jax.random.key(0), cfg))
+    plan = zero_lib.make_plan(mesh, params_shape)
+
+    rng = np.random.default_rng(0)
+    steps = 4
+    batches = [
+        (
+            rng.integers(0, 256, (8, 32), dtype=np.int32),
+            rng.integers(0, 256, (8, 32), dtype=np.int32),
+        )
+        for _ in range(steps)
+    ]
+
+    def run(zero_plan, grad_accum):
+        def init_state():
+            params = gpt.init(jax.random.key(0), cfg)
+            if zero_plan is not None:
+                opt_state = optimizer.init(
+                    zero_lib.update_view(params, zero_plan)
+                )
+            else:
+                opt_state = optimizer.init(params)
+            return {
+                "params": params, "opt_state": opt_state,
+                "step": jax.numpy.asarray(0, dtype=jax.numpy.int32),
+            }
+
+        shardings = state_shardings(
+            mesh, jax.eval_shape(init_state), zero_plan=zero_plan
+        )
+        state = jax.jit(init_state, out_shardings=shardings)()
+        step_fn = jax.jit(
+            make_train_step(cfg, optimizer, mesh, grad_accum=grad_accum,
+                            zero_plan=zero_plan),
+            in_shardings=(shardings, (batch_sharding,) * 2, repl),
+            out_shardings=(shardings, repl),
+        )
+        losses, update_norms = [], []
+        for x, y in batches:
+            xb = jax.device_put(x, batch_sharding)
+            yb = jax.device_put(y, batch_sharding)
+            state, m = step_fn(state, (xb, yb), jax.random.key(0))
+            losses.append(float(jax.device_get(m["loss"])))
+            update_norms.append(float(jax.device_get(m["update_norm"])))
+        return state, losses, update_norms
+
+    for ga in (1, 2):
+        base_state, base_losses, base_un = run(None, ga)
+        zero_state, zero_losses, zero_un = run(plan, ga)
+        if not np.allclose(base_losses, zero_losses, rtol=2e-4, atol=2e-4):
+            print(f"selftest-zero FAIL: grad_accum={ga} loss mismatch "
+                  f"base={base_losses} zero={zero_losses}")
+            rc = 1
+        if not all(np.isfinite(v) and v > 0 for v in zero_un):
+            print(f"selftest-zero FAIL: bad update_norm {zero_un}")
+            rc = 1
+        base_params = jax.device_get(base_state["params"])
+        zero_params = jax.device_get(zero_state["params"])
+        mismatched = []
+
+        def cmp(path, a, b):
+            if not np.allclose(a, b, rtol=2e-4, atol=2e-4):
+                mismatched.append(jax.tree_util.keystr(path))
+            return None
+
+        jax.tree_util.tree_map_with_path(cmp, base_params, zero_params)
+        if mismatched:
+            print(f"selftest-zero FAIL: grad_accum={ga} param mismatch "
+                  f"after {steps} steps: {mismatched}")
+            rc = 1
+        if ga == 1:
+            base_bytes = zero_lib.per_device_bytes(base_state["opt_state"])
+            zero_bytes = zero_lib.per_device_bytes(zero_state["opt_state"])
+            ratio = zero_bytes / max(base_bytes, 1)
+            print(f"selftest-zero: opt_state bytes/device "
+                  f"{base_bytes} -> {zero_bytes} (ratio {ratio:.3f}, dp=2)")
+            if ratio > 0.7:
+                print(f"selftest-zero FAIL: opt state not sharded "
+                      f"(ratio {ratio:.3f} > 0.7 at dp=2)")
+                rc = 1
+    print("selftest-zero", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument(
@@ -100,11 +247,18 @@ def main(argv=None) -> int:
              "or dataset needed",
     )
     parser.add_argument(
+        "--selftest-zero", action="store_true",
+        help="ZeRO dp update-sharding parity + memory smoke on a "
+             "host-platform dp=2 mesh; no config or dataset needed",
+    )
+    parser.add_argument(
         "overrides", nargs="*", help="dotted overrides: section.key=value"
     )
     args = parser.parse_args(argv)
     if args.selftest_faults:
         return selftest_faults()
+    if args.selftest_zero:
+        return selftest_zero()
 
     from mingpt_distributed_tpu.parallel import distributed
 
