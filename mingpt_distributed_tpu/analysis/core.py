@@ -105,8 +105,15 @@ class Config:
 
     # GL007: paths where wall-clock calls must go through the Clock
     # abstraction (serving chaos harness + fault injector are only
-    # deterministic because of it)
-    clock_paths: Tuple[str, ...] = ("serving/", "training/faults.py")
+    # deterministic because of it; request tracing and the flight
+    # recorder take every timestamp from an injected clock so the
+    # chaos-gate trace assertions stay exact)
+    clock_paths: Tuple[str, ...] = (
+        "serving/",
+        "training/faults.py",
+        "telemetry/tracing.py",
+        "telemetry/flightrec.py",
+    )
     # GL007: time.time() results bound to these names are telemetry
     # timestamps (epoch stamps on records), not scheduling decisions
     clock_ts_names: Tuple[str, ...] = (

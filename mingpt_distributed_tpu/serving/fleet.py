@@ -68,7 +68,13 @@ from mingpt_distributed_tpu.serving.requests import (
     ShedError,
 )
 from mingpt_distributed_tpu.serving.scheduler import InferenceServer
-from mingpt_distributed_tpu.telemetry import MetricsRegistry
+from mingpt_distributed_tpu.telemetry import MetricsRegistry, render_prometheus
+from mingpt_distributed_tpu.telemetry.flightrec import FlightRecorder
+from mingpt_distributed_tpu.telemetry.tracing import (
+    TraceContext,
+    TraceRecorder,
+    trace_baggage,
+)
 from mingpt_distributed_tpu.training.faults import (
     InjectedAdmissionError,
     ReplicaCrashed,
@@ -449,6 +455,7 @@ class FleetHandle:
     attempts: int = 0                    # submissions so far (1 = no retry yet)
     replica: Optional[str] = None        # current / last placement
     duplicates_suppressed: int = 0       # re-emitted token indices dropped
+    trace: Optional[TraceContext] = None  # root trace context (ISSUE 10)
 
 
 class Router:
@@ -465,6 +472,8 @@ class Router:
         shed_watermark: Optional[int] = None,
         breaker_failure_threshold: int = 3,
         breaker_reset_s: float = 1.0,
+        trace_recorder: Optional[TraceRecorder] = None,
+        flight: Optional[FlightRecorder] = None,
     ):
         self.supervisor = supervisor
         self.clock = supervisor.clock
@@ -473,6 +482,22 @@ class Router:
         self.max_retries = max_retries
         self.retry_backoff_s = retry_backoff_s
         self.shed_watermark = shed_watermark
+        # request-scoped tracing + flight recorder (ISSUE 10). The
+        # router mints ONE trace per fleet request at submit; each
+        # routed attempt is a fleet.attempt span whose child context
+        # rides on the attempt Request into the replica scheduler.
+        self.trace_recorder = trace_recorder
+        self.flight = flight
+        self._shed_ids = itertools.count()
+        if flight is not None:
+            # per-replica registry snapshots for crash dumps — lazy
+            # closures over the Replica wrapper, so they keep working
+            # after a respawn swaps rep.server
+            for rep in supervisor.replicas:
+                flight.metrics_providers.setdefault(
+                    rep.name,
+                    (lambda r=rep: render_prometheus(
+                        r.server.metrics.registry)))
         self.breakers: Dict[str, CircuitBreaker] = {
             rep.name: CircuitBreaker(
                 self.clock.now, breaker_failure_threshold, breaker_reset_s)
@@ -537,7 +562,18 @@ class Router:
     # -- wiring ---------------------------------------------------------
     def _wire_streaming(self) -> None:
         for rep in self.supervisor.replicas:
-            rep.server.on_token = self._make_emitter(rep.name)
+            self._wire_replica(rep)
+
+    def _wire_replica(self, rep: Replica) -> None:
+        """Router-side hooks on a (possibly freshly respawned) replica
+        server: streaming emitter, shared trace recorder, and the
+        watchdog's recompile-triggered flight dump."""
+        rep.server.on_token = self._make_emitter(rep.name)
+        rep.server.trace_recorder = self.trace_recorder
+        if self.flight is not None:
+            rep.server.watchdog.on_recompile = (
+                lambda grown, name=rep.name: self.flight.dump(
+                    "watchdog_recompile", replica=name, families=grown))
 
     def _make_emitter(self, replica_name: str):
         def emit(rh: RequestHandle, token: int) -> None:
@@ -553,6 +589,13 @@ class Router:
                 self._dup_suppressed.inc()
                 return
             fh.tokens.append(token)
+            # emit events on the FLEET clock, dedup-aware: only tokens
+            # that actually reach the caller become events, so a trace's
+            # emit count always equals the visible token count
+            if self.trace_recorder is not None and fh.trace is not None:
+                self.trace_recorder.add_event(
+                    fh.trace, "emit", self.clock.now(),
+                    token_index=len(fh.tokens) - 1, replica=replica_name)
             if self.on_token is not None:
                 self.on_token(fh, token)
         return emit
@@ -593,19 +636,39 @@ class Router:
                 self._finalize(fh, "deadline")
                 return True  # resolved (not placed) — stop trying
         fh.attempts += 1
+        # each attempt is a span in the ONE per-request trace; the child
+        # context rides on the attempt Request, so every span the
+        # replica's scheduler records parents under this attempt
+        attempt_ctx: Optional[TraceContext] = fh.trace
+        if self.trace_recorder is not None and fh.trace is not None:
+            attempt_ctx = self.trace_recorder.open_span(
+                fh.trace, "fleet.attempt", now,
+                attempt=fh.attempts, replica=rep.name)
         attempt_req = dataclasses.replace(
             fh.request,
             request_id=f"{fh.request_id}-a{fh.attempts}",
             deadline_s=remaining,
+            trace=attempt_ctx,
         )
         breaker = self.breakers[rep.name]
         try:
             rh = rep.submit(attempt_req)
         except QueueFullError:
             fh.attempts -= 1  # a full queue is not a failed attempt
+            if self.trace_recorder is not None and \
+                    attempt_ctx is not fh.trace and attempt_ctx is not None:
+                self.trace_recorder.cancel_span(attempt_ctx)
             return False
         except InjectedAdmissionError as e:
             fh.error = e
+            if self.trace_recorder is not None and \
+                    attempt_ctx is not fh.trace and attempt_ctx is not None:
+                self.trace_recorder.close_span(
+                    attempt_ctx, self.clock.now(), outcome="admit_error")
+                self.trace_recorder.add_event(
+                    fh.trace, "retry", self.clock.now(), reason="admit",
+                    attempt=fh.attempts)
+                self.trace_recorder.mark_forced(fh.trace)
             breaker.record_failure()
             self._retries.labels(reason="admit").inc()
             return False
@@ -650,11 +713,13 @@ class Router:
         now = self.clock.now()
         if self.draining:
             self._rejected.labels(reason="draining").inc()
+            self._trace_shed(request, "draining", now)
             raise ShedError("fleet is draining — not accepting new "
                             "requests", reason="draining")
         depth = self.fleet_queue_depth()
         if self.shed_watermark is not None and depth >= self.shed_watermark:
             self._rejected.labels(reason="shed").inc()
+            self._trace_shed(request, "shed", now)
             raise ShedError(
                 f"fleet queue depth {depth} >= watermark "
                 f"{self.shed_watermark} — shedding",
@@ -664,6 +729,7 @@ class Router:
             est = self._estimated_wait_s()
             if est > 0 and request.deadline_s <= est:
                 self._rejected.labels(reason="deadline").inc()
+                self._trace_shed(request, "deadline", now)
                 raise ShedError(
                     f"deadline {request.deadline_s:.3f}s cannot be met: "
                     f"estimated queue wait {est:.3f}s — shedding now "
@@ -673,6 +739,7 @@ class Router:
         if not any(self.breakers[rep.name].allow()
                    for rep in self.supervisor.ready_replicas()):
             self._rejected.labels(reason="breaker_open").inc()
+            self._trace_shed(request, "breaker_open", now)
             raise ShedError(
                 "every replica's circuit breaker is open — shedding",
                 reason="breaker_open",
@@ -686,6 +753,9 @@ class Router:
             deadline=(None if request.deadline_s is None
                       else now + request.deadline_s),
         )
+        if self.trace_recorder is not None:
+            fh.trace = self.trace_recorder.start_trace(
+                fh.request_id, now=now, baggage=trace_baggage(request))
         if not self._try_route(fh):
             # every candidate was queue-full / errored: park for the next
             # round rather than dropping accepted work
@@ -693,25 +763,63 @@ class Router:
         return fh
 
     # -- failure handling ------------------------------------------------
+    def _trace_shed(self, request: Request, reason: str,
+                    now: float) -> None:
+        """Shed decisions are traces too (always exported — trouble is
+        never sampled away): a tiny trace with one shed event and an
+        outcome of "shed"."""
+        rec = self.trace_recorder
+        if rec is None:
+            return
+        ctx = rec.start_trace(
+            f"fleet-shed-{next(self._shed_ids)}", now=now,
+            baggage=trace_baggage(request))
+        rec.add_event(ctx, "shed", now, reason=reason)
+        rec.end_trace(ctx, now=now, outcome="shed", n_tokens=0,
+                      attempts=0, shed_reason=reason)
+
     def _finalize(self, fh: FleetHandle, reason: str) -> None:
         fh.finished = True
         fh.finish_reason = reason
         outcome = "completed" if reason in ("length", "eos") else reason
         self._requests_total.labels(outcome=outcome).inc()
+        if self.trace_recorder is not None and fh.trace is not None:
+            self.trace_recorder.end_trace(
+                fh.trace, now=self.clock.now(), outcome=reason,
+                n_tokens=len(fh.tokens), attempts=fh.attempts,
+                replica=fh.replica,
+                duplicates_suppressed=fh.duplicates_suppressed)
 
     def _retry_or_fail(self, fh: FleetHandle, reason: str) -> None:
         if fh.attempts > self.max_retries:
             self._finalize(fh, "error")
             return
         self._retries.labels(reason=reason).inc()
+        if self.trace_recorder is not None and fh.trace is not None:
+            self.trace_recorder.add_event(
+                fh.trace, "retry", self.clock.now(), reason=reason,
+                attempt=fh.attempts)
+            self.trace_recorder.mark_forced(fh.trace)
         backoff = self.retry_backoff_s * (2 ** max(0, fh.attempts - 1))
         self._pending.append((fh, self.clock.now() + backoff))
+
+    def _close_attempt_span(self, fh: FleetHandle, rh: RequestHandle,
+                            outcome: str) -> None:
+        """Close the fleet.attempt span riding on this attempt's Request
+        (must happen before the trace is ended)."""
+        if self.trace_recorder is None:
+            return
+        ctx = rh.request.trace
+        if ctx is not None and ctx is not fh.trace:
+            self.trace_recorder.close_span(
+                ctx, self.clock.now(), outcome=outcome)
 
     def _resolve_finished(self, replica_name: str, fh: FleetHandle,
                           rh: RequestHandle, crashed: bool) -> None:
         """A replica-level handle finished: translate to fleet outcome."""
         if fh.finished:
             return
+        self._close_attempt_span(fh, rh, rh.finish_reason or "unknown")
         if rh.finish_reason in ("length", "eos"):
             fh.replica = replica_name
             self._finalize(fh, rh.finish_reason)
@@ -735,9 +843,13 @@ class Router:
                 self._resolve_finished(rep.name, fh, rh, crashed=True)
             elif not fh.finished:
                 fh.error = exc
+                self._close_attempt_span(fh, rh, "crash")
                 victims.append(fh)
         for fh in victims:
             self._retry_or_fail(fh, reason="crash")
+        if self.flight is not None:
+            self.flight.dump("crash", replica=rep.name, error=repr(exc),
+                             victims=len(victims))
 
     def _handle_step_failure(self, rep: Replica, exc: BaseException) -> None:
         """A scheduling round raised without killing the replica (poison).
@@ -745,7 +857,13 @@ class Router:
         per-slot mutation, so the next round recomputes the identical
         decode. Costs a breaker failure; repeated poison opens it."""
         self._step_failures.labels(replica=rep.name).inc()
-        self.breakers[rep.name].record_failure()
+        breaker = self.breakers[rep.name]
+        was_open = breaker.state == CircuitBreaker.OPEN
+        breaker.record_failure()
+        if (self.flight is not None and not was_open
+                and breaker.state == CircuitBreaker.OPEN):
+            self.flight.dump("breaker_trip", replica=rep.name,
+                             error=repr(exc))
 
     # -- the scheduling round ---------------------------------------------
     def step(self) -> bool:
@@ -754,7 +872,7 @@ class Router:
         routed request is unfinished."""
         now = self.clock.now()
         for rep in self.supervisor.poll_restarts():
-            rep.server.on_token = self._make_emitter(rep.name)
+            self._wire_replica(rep)
             self.breakers[rep.name].reset_to_probe()
 
         if (self._pending
@@ -831,6 +949,30 @@ class Router:
         handles = [self.submit(r) for r in requests]
         self.run_until_drained()
         return handles
+
+    def health_report(self) -> Dict[str, Any]:
+        """The /healthz payload (ISSUE 10): per-replica breaker state by
+        NAME (not the internal int) plus the health-gate reasons the
+        routing tier is acting on — what an operator needs to see why a
+        replica is being avoided."""
+        breaker_names = {CircuitBreaker.CLOSED: "closed",
+                         CircuitBreaker.HALF_OPEN: "half_open",
+                         CircuitBreaker.OPEN: "open"}
+        replicas = {}
+        for rep in self.supervisor.replicas:
+            h = rep.health()
+            replicas[rep.name] = {
+                "state": rep.state,
+                "breaker": breaker_names[self.breakers[rep.name].state],
+                "healthy": h.ready,
+                "reasons": h.reasons,
+            }
+        return {
+            "replicas": replicas,
+            "draining": self.draining,
+            "pending": len(self._pending),
+            "in_flight": len(self._attempts),
+        }
 
     def summary(self) -> Dict[str, Any]:
         return {

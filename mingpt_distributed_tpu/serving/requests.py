@@ -25,7 +25,10 @@ from __future__ import annotations
 
 import math
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence
+from typing import TYPE_CHECKING, List, Optional, Sequence
+
+if TYPE_CHECKING:  # annotation-only: requests stays telemetry-free
+    from mingpt_distributed_tpu.telemetry.tracing import TraceContext
 
 __all__ = [
     "QueueFullError",
@@ -86,6 +89,11 @@ class Request:
     seed: int = 0                  # per-request sampling PRNG seed
     deadline_s: Optional[float] = None  # expire this long after submit
     request_id: Optional[str] = None
+    tenant: Optional[str] = None   # trace baggage: who submitted this
+    # request-scoped trace context (ISSUE 10). The router stamps each
+    # retry attempt's Request with the attempt-span context, so every
+    # span a replica records parents into the one per-request trace.
+    trace: Optional["TraceContext"] = None
 
     def validate(
         self,
@@ -163,6 +171,11 @@ class RequestHandle:
     prefill_pos: int = 0
     prefix_rows: int = 0          # rows served from the shared-prefix store
     admit_time: Optional[float] = None
+    # tracing (ISSUE 10): the context in-replica spans parent to, and
+    # whether THIS server minted the trace (solo mode) and so owns emit
+    # events + end_trace — under a router, the router owns both
+    trace: Optional["TraceContext"] = None
+    trace_owner: bool = False
 
     @property
     def ttft_s(self) -> Optional[float]:

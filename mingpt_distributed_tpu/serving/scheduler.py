@@ -93,6 +93,18 @@ from mingpt_distributed_tpu.telemetry import (
     SpanTracer,
     log_event,
 )
+from mingpt_distributed_tpu.telemetry.tracing import (
+    TraceRecorder,
+    trace_baggage,
+)
+
+
+def _trace_attrs(handle: RequestHandle) -> Dict[str, Any]:
+    """trace_id attr for the process-level SpanTracer spans, so the
+    wall-time spans of ISSUE 5 land in the per-request timeline too."""
+    if handle.trace is None:
+        return {}
+    return {"trace_id": handle.trace.trace_id}
 
 
 class SlotTable:
@@ -184,6 +196,7 @@ class InferenceServer:
         recompile_fail: bool = False,
         strict_window: bool = False,
         fault_hook: Optional[Callable[[str], None]] = None,
+        trace_recorder: Optional[TraceRecorder] = None,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(
@@ -218,6 +231,13 @@ class InferenceServer:
         # the computed tokens are lost, never streamed, so retry-on-a-
         # survivor cannot double-emit.
         self.fault_hook = fault_hook
+        # request-scoped tracing (ISSUE 10). Settable attribute: the
+        # fleet router pushes its recorder onto every replica server
+        # (including respawned ones) after construction. A request
+        # arriving with a TraceContext (a router attempt) parents into
+        # that trace; one without gets a trace minted here (solo mode),
+        # and then this server also owns emit events + end_trace.
+        self.trace_recorder = trace_recorder
         self.queue: Deque[RequestHandle] = deque()
         self.slots = SlotTable(n_slots, cfg.block_size)
         self._ids = itertools.count()
@@ -264,6 +284,17 @@ class InferenceServer:
             submit_time=now,
             deadline=None if deadline_s is None else now + deadline_s,
         )
+        rec = self.trace_recorder
+        if request.trace is not None:
+            handle.trace = request.trace
+        elif rec is not None:
+            handle.trace = rec.start_trace(
+                handle.request_id, now=now, baggage=trace_baggage(request))
+            handle.trace_owner = True
+        if rec is not None and handle.trace is not None:
+            rec.add_event(handle.trace, "queued", now,
+                          request_id=handle.request_id,
+                          queue_depth=len(self.queue))
         self.queue.append(handle)
         self.metrics.on_submit()
         return handle
@@ -289,6 +320,15 @@ class InferenceServer:
         handle.last_token_time = now
         handle.tokens.append(token)
         self.metrics.on_tokens(1)
+        # emit events are recorded by whoever minted the trace — the
+        # router under a fleet (its clock, dedup-aware across retries),
+        # this server in solo mode — so each visible token is exactly
+        # one event even when a retried attempt replays a prefix
+        if (self.trace_recorder is not None and handle.trace is not None
+                and handle.trace_owner):
+            self.trace_recorder.add_event(
+                handle.trace, "emit", now,
+                token_index=len(handle.tokens) - 1)
         if self.on_token is not None:
             try:
                 self.on_token(handle, token)
@@ -317,6 +357,15 @@ class InferenceServer:
         self._release_slot(handle)
         span = (handle.last_token_time or 0.0) - (handle.first_token_time or 0.0)
         self.metrics.on_complete(len(handle.tokens), span)
+        self._end_owned_trace(handle)
+
+    def _end_owned_trace(self, handle: RequestHandle) -> None:
+        if (self.trace_recorder is not None and handle.trace is not None
+                and handle.trace_owner):
+            self.trace_recorder.end_trace(
+                handle.trace, now=self.clock(),
+                outcome=handle.finish_reason or "error",
+                n_tokens=len(handle.tokens), attempts=1)
 
     def _fail(self, handle: RequestHandle, reason: str) -> None:
         """Terminal non-success: deadline expiry (queued, mid-prefill or
@@ -329,6 +378,7 @@ class InferenceServer:
             self.metrics.on_expire()
         else:
             self.metrics.on_error()
+        self._end_owned_trace(handle)
 
     def _expire_if_due(self, handle: RequestHandle, now: float) -> bool:
         if handle.deadline is not None and now >= handle.deadline:
@@ -345,8 +395,20 @@ class InferenceServer:
         assert slot is not None
         handle.prefilling = True
         handle.admit_time = self.clock()
+        rec = self.trace_recorder
+        if rec is not None and handle.trace is not None:
+            rec.add_span(
+                handle.trace, "serve.queue_wait", ts=handle.submit_time,
+                dur_s=handle.admit_time - handle.submit_time,
+                request_id=handle.request_id)
         self.slots.bind(slot, handle, handle.request.seed)
+        t0 = self.clock()
         hit = self.engine.try_load_prefix(slot, handle.prompt_used)
+        if rec is not None and handle.trace is not None:
+            rec.add_span(
+                handle.trace, "serve.prefix_lookup", ts=t0,
+                dur_s=self.clock() - t0, hit_rows=hit,
+                request_id=handle.request_id)
         self.metrics.on_prefix_lookup(
             hit > 0, hit, enabled=self.engine.prefix_store is not None)
         handle.prefix_rows = hit
@@ -378,7 +440,13 @@ class InferenceServer:
             req.temperature, req.top_k, req.top_p, req.do_sample,
             jax.random.fold_in(self.slots.req_keys[slot], 0),
         )
-        self.metrics.on_prefill_chunk(end - pos, padded, self.clock() - t0)
+        t1 = self.clock()
+        self.metrics.on_prefill_chunk(end - pos, padded, t1 - t0)
+        if self.trace_recorder is not None and handle.trace is not None:
+            self.trace_recorder.add_span(
+                handle.trace, "serve.prefill_chunk", ts=t0, dur_s=t1 - t0,
+                pos=pos, tokens=end - pos, padded=padded,
+                request_id=handle.request_id)
         handle.prefill_pos = end
         if not last:
             return
@@ -414,7 +482,8 @@ class InferenceServer:
 
         while self.queue and self.engine.pool.free_count:
             h = self.queue.popleft()
-            with self.tracer.span("serve.admit", request_id=h.request_id):
+            with self.tracer.span("serve.admit", request_id=h.request_id,
+                                  **_trace_attrs(h)):
                 self._admit(h)
 
         # one chunk per prefilling slot per round: a long prompt's
@@ -424,12 +493,13 @@ class InferenceServer:
             if h.prefilling:
                 with self.tracer.span(
                         "serve.prefill_chunk", request_id=h.request_id,
-                        pos=h.prefill_pos):
+                        pos=h.prefill_pos, **_trace_attrs(h)):
                     self._prefill_one_chunk(h)
 
         active = self.slots.decoding_slots()
         if active:
             with self.tracer.span("serve.decode_round", lanes=len(active)):
+                td0 = self.clock()
                 for s in active:
                     self.slots.fold_key(s, len(self.slots.handles[s].tokens))
                 st = self.slots
@@ -437,6 +507,19 @@ class InferenceServer:
                     st.tokens, st.positions, st.temps, st.top_ks,
                     st.top_ps, st.do_sample, st.stacked_keys(),
                 )
+                # per-request decode-round spans cover the shared
+                # compiled step and are recorded BEFORE emission: a
+                # retiring emit ends its (solo-owned) trace, and a
+                # later-arriving span would be dropped as an orphan
+                if self.trace_recorder is not None:
+                    td1 = self.clock()
+                    for s in active:
+                        h = st.handles[s]
+                        if h.trace is not None:
+                            self.trace_recorder.add_span(
+                                h.trace, "serve.decode_round", ts=td0,
+                                dur_s=td1 - td0, lanes=len(active),
+                                request_id=h.request_id)
                 # chaos fault point: a raise here loses this round's
                 # computed tokens before any of them is emitted — the
                 # crash-mid-decode case the fleet retry must survive

@@ -17,6 +17,14 @@ The whole stack reports through this package:
   ``/healthz`` HTTP server.
 * ``watchdog``  — post-warmup recompile detection over the serving
   engine's compiled program families.
+* ``tracing``   — request-scoped traces (ISSUE 10): a TraceContext
+  minted at submit and propagated router → replica → scheduler, spans
+  and emit events collected per request, sampled ``mingpt-trace/1``
+  JSONL export with a strict loader.
+* ``flightrec`` — bounded flight-recorder ring dumped atomically on
+  crash / breaker trip / recompile / drain and via ``/debug/flight``.
+* ``slo``       — graded SLO reports from exact per-request trace
+  durations (not histogram-bucket upper bounds).
 
 Process-wide defaults: :func:`get_registry` / :func:`get_tracer` are the
 lazily-created singletons entry points (``train.py``, ``serve.py``) wire
@@ -34,7 +42,14 @@ from mingpt_distributed_tpu.telemetry.export import (
     JsonlEventSink,
     TelemetryServer,
     parse_prometheus,
+    register_build_info,
     render_prometheus,
+)
+from mingpt_distributed_tpu.telemetry.flightrec import (
+    FLIGHT_SCHEMA,
+    FlightRecorder,
+    load_flight_dir,
+    validate_flight_dump,
 )
 from mingpt_distributed_tpu.telemetry.peaks import (
     PEAK_FLOPS,
@@ -51,10 +66,27 @@ from mingpt_distributed_tpu.telemetry.registry import (
     MetricsRegistry,
     RateWindow,
 )
+from mingpt_distributed_tpu.telemetry.slo import (
+    SLO_SCHEMA,
+    SLObjective,
+    evaluate_slos,
+    exact_quantile,
+    parse_slo_spec,
+    render_slo_report,
+)
 from mingpt_distributed_tpu.telemetry.spans import (
     SpanTracer,
     log_event,
     process_index,
+)
+from mingpt_distributed_tpu.telemetry.tracing import (
+    TRACE_SCHEMA,
+    TraceContext,
+    TraceRecorder,
+    load_trace_jsonl,
+    trace_baggage,
+    trace_sink,
+    validate_trace_records,
 )
 from mingpt_distributed_tpu.telemetry.watchdog import (
     RecompileError,
@@ -62,11 +94,15 @@ from mingpt_distributed_tpu.telemetry.watchdog import (
 )
 
 __all__ = [
+    "FLIGHT_SCHEMA",
     "SCHEMA_VERSION",
+    "SLO_SCHEMA",
+    "TRACE_SCHEMA",
     "LATENCY_BUCKETS_S",
     "PEAK_FLOPS",
     "PEAK_HBM_BYTES",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "JsonlEventSink",
@@ -75,16 +111,30 @@ __all__ = [
     "RateWindow",
     "RecompileError",
     "RecompileWatchdog",
+    "SLObjective",
     "SpanTracer",
     "TelemetryServer",
+    "TraceContext",
+    "TraceRecorder",
+    "evaluate_slos",
+    "exact_quantile",
     "get_registry",
     "get_tracer",
+    "load_flight_dir",
+    "load_trace_jsonl",
     "log_event",
     "parse_prometheus",
+    "parse_slo_spec",
     "peak_flops_per_chip",
     "peak_hbm_bytes_per_chip",
     "process_index",
+    "register_build_info",
     "render_prometheus",
+    "render_slo_report",
+    "trace_baggage",
+    "trace_sink",
+    "validate_flight_dump",
+    "validate_trace_records",
 ]
 
 _registry: Optional[MetricsRegistry] = None

@@ -36,6 +36,7 @@ __all__ = [
     "JsonlEventSink",
     "TelemetryServer",
     "parse_prometheus",
+    "register_build_info",
     "render_prometheus",
 ]
 
@@ -47,14 +48,18 @@ SCHEMA_VERSION = "mingpt-telemetry/1"
 class JsonlEventSink:
     """Append-only, versioned JSONL event stream (thread-safe)."""
 
-    def __init__(self, path: Optional[str] = None, file: Optional[TextIO] = None):
+    def __init__(self, path: Optional[str] = None, file: Optional[TextIO] = None,
+                 schema: str = SCHEMA_VERSION):
         if (path is None) == (file is None):
             raise ValueError("give exactly one of path / file")
         self._file = file if file is not None else open(path, "a")
         self._lock = threading.Lock()
+        #: per-sink schema tag — the trace recorder reuses this sink
+        #: with "mingpt-trace/1" (payloads always carry their own ts)
+        self.schema = schema
 
     def write(self, kind: str, data: Dict[str, Any]) -> None:
-        rec = {"schema": SCHEMA_VERSION, "kind": kind}
+        rec = {"schema": self.schema, "kind": kind}
         rec.setdefault("ts", data.get("ts", time.time()))
         rec.update(data)
         line = json.dumps(rec) + "\n"
@@ -261,24 +266,64 @@ def parse_prometheus(text: str) -> Dict[str, Any]:
     return {"types": types, "samples": samples}
 
 
+def register_build_info(registry: MetricsRegistry):
+    """The Prometheus build-info idiom (ISSUE 10): a constant-1 gauge
+    whose labels carry the package and jax/jaxlib versions, so a scrape
+    can answer "what exactly is this replica running".  Version lookup
+    never initializes a JAX backend (``__version__`` only) and degrades
+    to ``unavailable`` when the library is absent."""
+    from mingpt_distributed_tpu import __version__
+
+    try:
+        import jax
+        jax_version = jax.__version__
+    except Exception:
+        jax_version = "unavailable"
+    try:
+        import jaxlib
+        jaxlib_version = jaxlib.__version__
+    except Exception:
+        jaxlib_version = "unavailable"
+    g = registry.gauge(
+        "mingpt_build_info",
+        help="constant 1; labels carry package/jax/jaxlib versions",
+        labels=("version", "jax", "jaxlib"))
+    g.labels(version=__version__, jax=jax_version,
+             jaxlib=jaxlib_version).set(1)
+    return g
+
+
 # ---------------------------------------------------------------------------
-# Pull endpoint: /metrics + /healthz on a stdlib threading HTTP server
+# Pull endpoint: /metrics + /healthz + /debug/flight on a stdlib server
 # ---------------------------------------------------------------------------
 
 
 class TelemetryServer:
-    """``/metrics`` (Prometheus text) and ``/healthz`` (JSON liveness)
-    on a daemon-threaded stdlib server. ``port=0`` binds an ephemeral
-    port (exposed as ``.port``) — what the CI smoke uses so parallel
-    runs never collide."""
+    """``/metrics`` (Prometheus text), ``/healthz`` (JSON liveness +
+    fleet health) and ``/debug/flight`` (on-demand flight-recorder
+    snapshot) on a daemon-threaded stdlib server. ``port=0`` binds an
+    ephemeral port (exposed as ``.port``) — what the CI smoke uses so
+    parallel runs never collide.
+
+    ``health_provider`` / ``flight_provider`` are settable attributes
+    (read per request, so they can be wired after backend
+    construction): the former returns a dict merged into the healthz
+    document — serve.py wires ``Router.health_report`` so /healthz
+    carries per-replica breaker state and health-gate reasons (ISSUE
+    10) — the latter returns a flight snapshot document; without one,
+    ``/debug/flight`` is 404."""
 
     def __init__(
         self,
         registry: MetricsRegistry,
         port: int = 0,
         host: str = "127.0.0.1",
+        health_provider=None,
+        flight_provider=None,
     ):
         self.registry = registry
+        self.health_provider = health_provider
+        self.flight_provider = flight_provider
         self._t0 = time.time()
         outer = self
 
@@ -289,10 +334,30 @@ class TelemetryServer:
                     body = render_prometheus(outer.registry).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/healthz":
-                    body = json.dumps({
+                    doc = {
                         "status": "ok",
                         "uptime_s": round(time.time() - outer._t0, 3),
-                    }).encode()
+                    }
+                    hp = outer.health_provider
+                    if hp is not None:
+                        try:
+                            doc.update(hp())
+                        except Exception as e:  # liveness must survive
+                            doc["status"] = "error"
+                            doc["health_provider_error"] = repr(e)
+                    body = json.dumps(doc).encode()
+                    ctype = "application/json"
+                elif path == "/debug/flight":
+                    fp = outer.flight_provider
+                    if fp is None:
+                        self.send_error(
+                            404, "no flight recorder configured")
+                        return
+                    try:
+                        snap = fp()
+                    except Exception as e:
+                        snap = {"error": repr(e)}
+                    body = json.dumps(snap, default=repr).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404, "unknown path (try /metrics)")

@@ -1,0 +1,195 @@
+"""SLO engine (ISSUE 10): grade named latency/availability objectives
+from *exact* per-request trace durations.
+
+The process histograms (``Histogram.quantile``) answer "roughly where
+is p99" from a fixed bucket ladder — the reported quantile is a bucket
+*upper bound*, which can overstate the true p99 by the bucket width.
+The trace recorder keeps every finished request's exact TTFT and
+inter-token gaps, so SLO attainment is computed here from the real
+order statistics instead (``exact_quantile``), and shed rate from
+outcome counts rather than a sampled counter.
+
+Spec grammar (CLI ``--slo`` and ``parse_slo_spec``)::
+
+    ttft_p99<=0.5,itl_p99<=0.1,shed_rate<=0.05
+
+Metrics: ``ttft_pNN`` (seconds, per-request time-to-first-token),
+``itl_pNN`` (seconds, pooled inter-token gaps across all requests),
+``shed_rate`` and ``error_rate`` (fractions of all finished requests).
+Report schema: ``mingpt-slo/1``.
+"""
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SLO_SCHEMA = "mingpt-slo/1"
+
+DEFAULT_SLO_SPEC = "ttft_p99<=0.5,itl_p99<=0.1,shed_rate<=0.05"
+
+_METRIC_RE = re.compile(r"^(ttft|itl)_p(\d{1,2})$")
+_RATE_METRICS = ("shed_rate", "error_rate")
+
+#: grade ladder: fraction of evaluable objectives attained -> letter
+_GRADES = ((1.0, "A"), (0.8, "B"), (0.6, "C"), (0.4, "D"))
+
+
+@dataclass(frozen=True)
+class SLObjective:
+    """One named objective: ``metric <= threshold``."""
+
+    name: str
+    metric: str
+    threshold: float
+
+    def __post_init__(self):
+        if not _METRIC_RE.match(self.metric) and \
+                self.metric not in _RATE_METRICS:
+            raise ValueError(
+                f"unknown SLO metric {self.metric!r} (want ttft_pNN, "
+                f"itl_pNN, shed_rate or error_rate)")
+        if not math.isfinite(self.threshold) or self.threshold < 0:
+            raise ValueError(
+                f"SLO threshold must be finite and >= 0, "
+                f"got {self.threshold!r}")
+
+
+def parse_slo_spec(spec: str) -> Tuple[SLObjective, ...]:
+    """Parse ``metric<=threshold[,metric<=threshold...]``; the literal
+    spec ``default`` expands to DEFAULT_SLO_SPEC."""
+    if spec.strip() == "default":
+        spec = DEFAULT_SLO_SPEC
+    objectives = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "<=" not in part:
+            raise ValueError(f"bad SLO clause {part!r}: want "
+                             f"'metric<=threshold'")
+        metric, _, raw = part.partition("<=")
+        try:
+            threshold = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"bad SLO threshold {raw!r} in {part!r}") from None
+        metric = metric.strip()
+        objectives.append(SLObjective(metric, metric, threshold))
+    if not objectives:
+        raise ValueError(f"SLO spec {spec!r} names no objectives")
+    return tuple(objectives)
+
+
+def exact_quantile(values: Sequence[float], q: float) -> Optional[float]:
+    """Exact order-statistic quantile (nearest-rank on the sorted
+    sample) — contrast with Histogram.quantile's bucket upper bound."""
+    if not values:
+        return None
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"q must be in [0, 1], got {q}")
+    xs = sorted(float(v) for v in values)
+    rank = max(1, math.ceil(q * len(xs)))
+    return xs[rank - 1]
+
+
+def _observe(metric: str, requests: Sequence[Dict[str, Any]],
+             ) -> Optional[float]:
+    total = len(requests)
+    m = _METRIC_RE.match(metric)
+    if m is not None:
+        field, pct = m.group(1), int(m.group(2)) / 100.0
+        if field == "ttft":
+            vals = [r["ttft_s"] for r in requests
+                    if r.get("ttft_s") is not None]
+        else:
+            vals = [g for r in requests for g in (r.get("itl_s") or [])]
+        return exact_quantile(vals, pct)
+    if total == 0:
+        return None
+    if metric == "shed_rate":
+        return sum(1 for r in requests
+                   if r.get("outcome") == "shed") / total
+    if metric == "error_rate":
+        bad = sum(1 for r in requests
+                  if r.get("outcome") not in ("length", "eos", "shed"))
+        return bad / total
+    raise ValueError(f"unknown SLO metric {metric!r}")
+
+
+def evaluate_slos(requests: Sequence[Dict[str, Any]],
+                  objectives: Sequence[SLObjective],
+                  ) -> Dict[str, Any]:
+    """Grade ``objectives`` against per-request trace summaries (the
+    TraceRecorder's ``completed_requests()`` or ``request`` records
+    loaded from a mingpt-trace/1 JSONL).  Objectives with no data are
+    reported but excluded from the grade."""
+    requests = list(requests)
+    rows = []
+    evaluable = attained = 0
+    for obj in objectives:
+        observed = _observe(obj.metric, requests)
+        ok: Optional[bool] = None
+        margin: Optional[float] = None
+        if observed is not None:
+            ok = observed <= obj.threshold
+            margin = obj.threshold - observed
+            evaluable += 1
+            attained += int(ok)
+        rows.append({"name": obj.name, "metric": obj.metric,
+                     "threshold": obj.threshold, "observed": observed,
+                     "pass": ok, "margin": margin})
+    attainment = (attained / evaluable) if evaluable else None
+    grade = "n/a"
+    if attainment is not None:
+        grade = "F"
+        for floor, letter in _GRADES:
+            if attainment >= floor:
+                grade = letter
+                break
+    outcomes: Dict[str, int] = {}
+    for r in requests:
+        o = str(r.get("outcome"))
+        outcomes[o] = outcomes.get(o, 0) + 1
+    return {
+        "schema": SLO_SCHEMA,
+        "requests": len(requests),
+        "outcomes": outcomes,
+        "objectives": rows,
+        "evaluable": evaluable,
+        "attained": attained,
+        "attainment": attainment,
+        "grade": grade,
+    }
+
+
+def render_slo_report(report: Dict[str, Any]) -> str:
+    """Human-readable graded report (one block, stable layout)."""
+    lines = [f"SLO report ({report['schema']}): grade "
+             f"{report['grade']} — {report['attained']}/"
+             f"{report['evaluable']} objectives attained over "
+             f"{report['requests']} requests"]
+    if report["outcomes"]:
+        parts = ", ".join(f"{k}={v}" for k, v in
+                          sorted(report["outcomes"].items()))
+        lines.append(f"  outcomes: {parts}")
+    for row in report["objectives"]:
+        if row["observed"] is None:
+            lines.append(f"  [ n/a  ] {row['name']:<12} "
+                         f"<= {row['threshold']:g}  (no data)")
+            continue
+        verdict = "PASS" if row["pass"] else "FAIL"
+        lines.append(
+            f"  [ {verdict} ] {row['name']:<12} <= {row['threshold']:g}"
+            f"  observed {row['observed']:.6g}"
+            f"  margin {row['margin']:+.6g}")
+    return "\n".join(lines)
+
+
+def load_trace_requests(path: str) -> List[Dict[str, Any]]:
+    """Pull the per-request summaries out of a mingpt-trace/1 JSONL
+    (strictly validated) for offline SLO evaluation."""
+    from .tracing import load_trace_jsonl
+
+    traces = load_trace_jsonl(path)
+    return [tr["request"] for tr in traces.values()]

@@ -63,6 +63,10 @@ class RecompileWatchdog:
             labels=("family",),
         )
         self.recompiles = 0  # total counted by this watchdog instance
+        #: optional hook called with {family: new_traces} whenever
+        #: growth is detected — serve.py wires the flight recorder's
+        #: dump here (ISSUE 10), before any hard-fail raise
+        self.on_recompile: Optional[Callable[[Dict[str, int]], None]] = None
 
     @property
     def armed(self) -> bool:
@@ -97,6 +101,8 @@ class RecompileWatchdog:
             f"recompile watchdog: {total} post-warmup compile(s) ({detail})",
             tracer=self.tracer,
         )
+        if self.on_recompile is not None:
+            self.on_recompile(dict(grown))
         if self.hard_fail:
             raise RecompileError(
                 f"post-warmup recompile detected: {detail} — the compiled "

@@ -84,15 +84,34 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python train.py --selftest-faults
 
-# Serving chaos gate (ISSUE 6): a 3-replica in-process fleet on a virtual
-# clock with injected faults — replica0 crashes mid-decode (its in-flight
-# requests retry on survivors), replica1 runs with injected clock skew
-# (health-gated on ITL p99 without a single wall sleep). Asserts greedy
-# token-identical output vs solo generate() for every request, zero
-# duplicate tokens in the caller-visible stream, breaker/retry/restart
-# counters visible in a strict-parsed /metrics scrape, and drain-time
-# shedding. Exits non-zero on any violation.
+# Serving chaos gate (ISSUE 6 + ISSUE 10): a 3-replica in-process fleet
+# on a virtual clock with injected faults — replica0 crashes mid-decode
+# (its in-flight requests retry on survivors), replica1 runs with
+# injected clock skew (health-gated on ITL p99 without a single wall
+# sleep). Asserts greedy token-identical output vs solo generate() for
+# every request, zero duplicate tokens in the caller-visible stream,
+# breaker/retry/restart counters visible in a strict-parsed /metrics
+# scrape, and drain-time shedding. With tracing + the flight recorder
+# enabled (ISSUE 10) the gate additionally strict-validates the exported
+# mingpt-trace/1 stream (ONE trace per request, attempt spans matching
+# the retry count, emit events matching the stream, zero orphan
+# records), requires crash- and drain-triggered mingpt-flight/1 dumps to
+# parse through the atomic manifest, checks /healthz breaker detail +
+# /debug/flight, and grades the run against (generous) SLOs. Exits
+# non-zero on any violation.
+OBS_DIR="$(mktemp -d)"
+trap 'rm -rf "$OBS_DIR"' EXIT
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
-    python serve.py --selftest-chaos
+    python serve.py --selftest-chaos \
+        --trace-jsonl "$OBS_DIR/trace.jsonl" \
+        --flight-dir "$OBS_DIR/flight" \
+        --slo "ttft_p99<=60,itl_p99<=60,shed_rate<=0.5"
+
+# The exported artifacts must round-trip through the offline tool too:
+# trace_summary renders per-request timelines + the SLO grade from the
+# same files the gate just validated in-process.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python tools/trace_summary.py "$OBS_DIR/trace.jsonl" \
+        --slo "ttft_p99<=60,itl_p99<=60,shed_rate<=0.5" > /dev/null

@@ -64,6 +64,19 @@ trainer's requeue convention; a second SIGINT aborts hard). The
 replicas under an injected crash-mid-decode + slow replica and asserts
 greedy parity with solo generate(), zero duplicate streamed tokens and
 the breaker/retry/shed counters on a strict-parsed /metrics scrape.
+
+Observability knobs (ISSUE 10): --trace-jsonl PATH exports one
+``mingpt-trace/1`` record stream per request (spans + emit events + a
+request summary), --trace-sample P samples the happy path (errors,
+sheds and retries always export); --flight-dir DIR arms the crash
+flight recorder — recent spans/events/metrics dumped atomically on
+crash, breaker trip, watchdog recompile and SIGTERM drain, and
+on-demand via GET /debug/flight on the telemetry server; --slo [SPEC]
+prints a graded SLO report (TTFT/ITL percentiles + shed rate from
+exact per-request trace durations) at shutdown. With tracing on, the
+chaos gate additionally strict-validates the exported trace stream
+(one trace per request, attempt spans matching the retry count, zero
+orphan spans) and the dumped flight records.
 """
 
 from __future__ import annotations
@@ -137,6 +150,27 @@ def build_argparser() -> argparse.ArgumentParser:
                         "delay=0.25:match=replica1' (default: "
                         "MINGPT_SERVING_FAULTS env; ops crash|poison|"
                         "slow|admit)")
+    p.add_argument("--trace-jsonl", default=None,
+                   help="export request-scoped traces (mingpt-trace/1 "
+                        "JSONL: spans, emit events, one request summary "
+                        "per trace) to this path")
+    p.add_argument("--trace-sample", type=float, default=1.0,
+                   help="happy-path trace sampling probability in [0, 1]; "
+                        "errors, sheds and retried requests always export "
+                        "(default 1.0)")
+    p.add_argument("--flight-dir", default=None,
+                   help="arm the flight recorder: dump recent "
+                        "spans/events/metrics here (mingpt-flight/1, "
+                        "atomic write + manifest) on crash, breaker trip, "
+                        "recompile and SIGTERM drain; also enables GET "
+                        "/debug/flight on --metrics-port")
+    p.add_argument("--slo", nargs="?", const="default", default=None,
+                   metavar="SPEC",
+                   help="print a graded SLO report at shutdown from exact "
+                        "per-request trace durations; SPEC is "
+                        "'metric<=threshold' clauses (ttft_pNN, itl_pNN, "
+                        "shed_rate, error_rate) joined by ','; bare --slo "
+                        "uses the default objectives")
     p.add_argument("--selftest-chaos", action="store_true",
                    help="random-init tiny model through 3 replicas under "
                         "injected crash + slow faults; verifies greedy "
@@ -199,12 +233,58 @@ def _start_telemetry(args):
     from mingpt_distributed_tpu import telemetry
 
     reg = telemetry.get_registry()
+    telemetry.register_build_info(reg)
     if args.metrics_port is None:
         return reg, None
     tserver = telemetry.TelemetryServer(reg, port=args.metrics_port)
     print(f"[serve] telemetry: /metrics and /healthz on {tserver.url('')}",
           file=sys.stderr)
     return reg, tserver
+
+
+def _make_observability(args, reg):
+    """(TraceRecorder | None, FlightRecorder | None) from the ISSUE 10
+    flags. The flight recorder samples the process registry and the
+    span tracer's ring at dump time; the trace recorder mirrors every
+    span/event it records into the flight ring, so a crash dump carries
+    the requests that were in flight when it happened. --slo needs the
+    per-request summaries, so it forces a recorder even without an
+    export path."""
+    from mingpt_distributed_tpu import telemetry
+
+    flight = None
+    if args.flight_dir is not None:
+        flight = telemetry.FlightRecorder(
+            out_dir=args.flight_dir, registry=reg)
+        flight.source_providers["tracer"] = telemetry.get_tracer().records
+        flight.metrics_providers["process"] = (
+            lambda: telemetry.render_prometheus(reg))
+    recorder = None
+    if (args.trace_jsonl is not None or args.slo is not None
+            or flight is not None):
+        if not 0.0 <= args.trace_sample <= 1.0:
+            raise SystemExit(
+                f"--trace-sample must be in [0, 1], got {args.trace_sample}")
+        sink = (telemetry.trace_sink(args.trace_jsonl)
+                if args.trace_jsonl is not None else None)
+        recorder = telemetry.TraceRecorder(
+            sink=sink, sample=args.trace_sample, registry=reg, flight=flight)
+    return recorder, flight
+
+
+def _slo_report(args, recorder):
+    """Evaluate --slo objectives over the recorder's completed-request
+    summaries and print the graded report; returns the report dict (or
+    None without --slo/requests)."""
+    from mingpt_distributed_tpu import telemetry
+
+    if args.slo is None or recorder is None:
+        return None
+    objectives = telemetry.parse_slo_spec(args.slo)
+    report = telemetry.evaluate_slos(recorder.completed_requests(),
+                                     objectives)
+    print(telemetry.render_slo_report(report))
+    return report
 
 
 def _request_for(args, tokens, eos_id=None):
@@ -342,6 +422,7 @@ def _selftest_scrape(tserver) -> int:
         "mingpt_train_loss": "gauge",
         "mingpt_train_mfu": "gauge",
         "mingpt_recompiles_total": "counter",
+        "mingpt_build_info": "gauge",
     }
     for name, kind in required.items():
         got = parsed["types"].get(name)
@@ -400,6 +481,9 @@ def selftest_chaos(args) -> int:
     if args.metrics_port is None:
         args.metrics_port = 0  # the scrape assertions are part of the gate
     reg, tserver = _start_telemetry(args)
+    recorder, flight = _make_observability(args, reg)
+    if tserver is not None and flight is not None:
+        tserver.flight_provider = lambda: flight.snapshot("on_demand")
     injector = ServingFaultInjector(spec)
     supervisor = ReplicaSupervisor(
         default_server_factory(params, cfg, n_slots=2, **_server_kwargs(args)),
@@ -418,7 +502,10 @@ def selftest_chaos(args) -> int:
 
     router = Router(
         supervisor, on_token=on_token, max_retries=3, retry_backoff_s=0.01,
-        breaker_reset_s=0.05, shed_watermark=args.shed_watermark)
+        breaker_reset_s=0.05, shed_watermark=args.shed_watermark,
+        trace_recorder=recorder, flight=flight)
+    if tserver is not None:
+        tserver.health_provider = router.health_report
     handles = [router.submit(Request(prompt=p, max_new_tokens=max_new))
                for p in prompts]
     router.run_until_drained(max_steps=5000)
@@ -480,8 +567,13 @@ def selftest_chaos(args) -> int:
         print("selftest-chaos FAIL: draining rejection not counted")
         rc = 1
 
+    if flight is not None:
+        flight.dump("sigterm_drain")  # the artifact shutdown() writes
+    if recorder is not None:
+        rc |= _chaos_observability_checks(args, recorder, flight, handles)
+
     if tserver is not None:
-        rc |= _chaos_scrape(tserver)
+        rc |= _chaos_scrape(tserver, has_flight=flight is not None)
         tserver.close()
     if args.metrics_json:
         with open(args.metrics_json, "w") as f:
@@ -491,13 +583,131 @@ def selftest_chaos(args) -> int:
     return rc
 
 
-def _chaos_scrape(tserver) -> int:
+def _chaos_observability_checks(args, recorder, flight, handles) -> int:
+    """The ISSUE 10 acceptance bar, run inside the chaos gate whenever
+    tracing is enabled: every completed request yields exactly ONE
+    strict-valid trace whose attempt spans match the retry count and
+    whose emit events match the streamed tokens; crash- and
+    drain-triggered flight dumps strict-parse via the manifest; the
+    --slo report grades from the exact trace durations."""
+    from mingpt_distributed_tpu import telemetry
+
+    rc = 0
+    if recorder.active_traces:
+        print(f"selftest-chaos FAIL: {recorder.active_traces} trace(s) "
+              f"still open after drain")
+        rc = 1
+    if recorder.orphan_records:
+        print(f"selftest-chaos FAIL: {recorder.orphan_records} orphan "
+              f"trace record(s)")
+        rc = 1
+    report = _slo_report(args, recorder)
+    if args.slo is not None and (report is None or not report.get("grade")):
+        print("selftest-chaos FAIL: --slo produced no graded report")
+        rc = 1
+    recorder.close()  # flush the JSONL sink before strict-loading it
+
+    if args.trace_jsonl is not None:
+        try:
+            traces = telemetry.load_trace_jsonl(args.trace_jsonl)
+        except ValueError as e:
+            print(f"selftest-chaos FAIL: trace stream invalid: {e}")
+            return 1
+        retried_traces = 0
+        for h in handles:
+            t = traces.get(h.request_id)
+            if t is None:
+                print(f"selftest-chaos FAIL: no trace for {h.request_id}")
+                rc = 1
+                continue
+            emits = [e for e in t["events"] if e["name"] == "emit"]
+            attempts = [s for s in t["spans"]
+                        if s["name"] == "fleet.attempt"]
+            retries = [e for e in t["events"] if e["name"] == "retry"]
+            checks = [
+                ("one emit event per streamed token",
+                 len(emits) == len(h.tokens)),
+                ("one attempt span per attempt",
+                 len(attempts) == h.attempts),
+                ("retry events mark every extra attempt",
+                 len(retries) == h.attempts - 1),
+                ("summary agrees with the handle",
+                 t["request"]["attempts"] == h.attempts
+                 and t["request"]["n_tokens"] == len(h.tokens)
+                 and t["request"]["outcome"] == h.finish_reason),
+                ("scheduler spans joined the fleet trace",
+                 {"serve.queue_wait", "serve.prefix_lookup",
+                  "serve.decode_round"}
+                 <= {s["name"] for s in t["spans"]}),
+            ]
+            for what, ok in checks:
+                if not ok:
+                    print(f"selftest-chaos FAIL {h.request_id}: {what}")
+                    rc = 1
+            retried_traces += h.attempts > 1
+        if not retried_traces:
+            print("selftest-chaos FAIL: no retried request in the trace "
+                  "stream (crash did not land?)")
+            rc = 1
+        shed = [t for t in traces.values()
+                if t["request"]["outcome"] == "shed"]
+        if len(shed) != 1:
+            print(f"selftest-chaos FAIL: expected 1 forced shed trace, "
+                  f"got {len(shed)}")
+            rc = 1
+        print(f"selftest-chaos traces: {len(traces)} trace(s), "
+              f"{retried_traces} retried, {len(shed)} shed")
+
+    if flight is not None and flight.out_dir is not None:
+        try:
+            manifest, docs = telemetry.load_flight_dir(flight.out_dir)
+        except (OSError, ValueError) as e:
+            print(f"selftest-chaos FAIL: flight dir invalid: {e}")
+            return 1
+        triggers = [d["trigger"] for d in docs]
+        for want in ("crash", "sigterm_drain"):
+            if want not in triggers:
+                print(f"selftest-chaos FAIL: no {want!r} flight dump "
+                      f"(got {triggers})")
+                rc = 1
+        print(f"selftest-chaos flight: {len(docs)} dump(s) {triggers}, "
+              f"latest {manifest['latest']}")
+    return rc
+
+
+def _chaos_scrape(tserver, has_flight: bool = False) -> int:
     """Strict-parse our own /metrics and assert the fleet resilience
     families are present — breaker state, retries, crashes, restarts,
-    per-reason rejections, duplicate-token suppression."""
+    per-reason rejections, duplicate-token suppression. /healthz must
+    carry the per-replica breaker + health-gate detail (ISSUE 10) and,
+    with the flight recorder armed, /debug/flight must serve a
+    strict-valid snapshot."""
     import urllib.request
 
-    from mingpt_distributed_tpu.telemetry import parse_prometheus
+    from mingpt_distributed_tpu.telemetry import (
+        parse_prometheus,
+        validate_flight_dump,
+    )
+
+    rc = 0
+    with urllib.request.urlopen(tserver.url("/healthz"), timeout=10) as resp:
+        health = json.loads(resp.read().decode())
+    reps = health.get("replicas")
+    if not isinstance(reps, dict) or not all(
+            "breaker" in v and "reasons" in v for v in reps.values()):
+        print(f"selftest-chaos FAIL: /healthz lacks per-replica breaker "
+              f"state + health reasons: {health}")
+        rc = 1
+    if has_flight:
+        with urllib.request.urlopen(tserver.url("/debug/flight"),
+                                    timeout=10) as resp:
+            snap = json.loads(resp.read().decode())
+        try:
+            validate_flight_dump(snap)
+        except ValueError as e:
+            print(f"selftest-chaos FAIL: /debug/flight snapshot "
+                  f"invalid: {e}")
+            rc = 1
 
     with urllib.request.urlopen(tserver.url("/metrics"), timeout=10) as resp:
         text = resp.read().decode()
@@ -507,7 +717,6 @@ def _chaos_scrape(tserver) -> int:
         print(f"selftest-chaos FAIL: /metrics is not valid exposition "
               f"text: {e}")
         return 1
-    rc = 0
     required = {
         "mingpt_serving_rejected_total": "counter",
         "mingpt_fleet_retries_total": "counter",
@@ -589,6 +798,9 @@ def main(argv=None) -> int:
 
     guard = _ShutdownGuard().install()
     reg, tserver = _start_telemetry(args)
+    recorder, flight = _make_observability(args, reg)
+    if tserver is not None and flight is not None:
+        tserver.flight_provider = lambda: flight.snapshot("on_demand")
 
     def build_backend(stream_cb):
         """One InferenceServer by default; --replicas N puts the fleet
@@ -616,23 +828,42 @@ def main(argv=None) -> int:
                 injector=injector if injector.specs else None,
                 registry=reg,
             )
-            return Router(supervisor, on_token=stream_cb,
-                          shed_watermark=args.shed_watermark)
-        return InferenceServer(params, gpt_cfg, n_slots=args.slots,
-                               on_token=stream_cb,
-                               log_every=(0 if stream_cb else args.log_every),
-                               max_queue=args.queue_limit,
-                               default_deadline_s=args.deadline_s,
-                               registry=reg,
-                               **_server_kwargs(args))
+            router = Router(supervisor, on_token=stream_cb,
+                            shed_watermark=args.shed_watermark,
+                            trace_recorder=recorder, flight=flight)
+            if tserver is not None:
+                tserver.health_provider = router.health_report
+            return router
+        server = InferenceServer(params, gpt_cfg, n_slots=args.slots,
+                                 on_token=stream_cb,
+                                 log_every=(0 if stream_cb
+                                            else args.log_every),
+                                 max_queue=args.queue_limit,
+                                 default_deadline_s=args.deadline_s,
+                                 registry=reg,
+                                 trace_recorder=recorder,
+                                 **_server_kwargs(args))
+        if flight is not None:
+            server.watchdog.on_recompile = (
+                lambda grown: flight.dump("watchdog_recompile",
+                                          families=grown))
+        return server
 
     def shutdown(backend) -> int:
         """Common exit path: drain in-flight work, flush metrics, close
         the telemetry endpoint; exit 75 after a signal so schedulers
-        requeue instead of failing the job."""
+        requeue instead of failing the job. Under the flight recorder a
+        signalled drain also dumps a flight record (the crash-adjacent
+        evidence a preemption would otherwise discard); --slo prints
+        its graded report from the completed-request traces."""
         if guard.stop_requested and hasattr(backend, "drain"):
             backend.drain()
         backend.run_until_drained()
+        if guard.stop_requested and flight is not None:
+            flight.dump("sigterm_drain")
+        _slo_report(args, recorder)
+        if recorder is not None:
+            recorder.close()
         if args.metrics_json:
             if hasattr(backend, "metrics"):
                 backend.metrics.write_json(args.metrics_json)

@@ -1,0 +1,37 @@
+"""GL007 fixtures — the tracing/flight-recorder clock contract.
+
+The request-trace recorder and flight recorder live in GL007 scope
+(ISSUE 10): every span/event timestamp must be caller-supplied from an
+injected clock, never read in-module — otherwise the chaos gate's
+exact-duration trace assertions would depend on wall time.
+
+Positives: a recorder reading the wall clock to stamp a span, and a
+sleep-based flush backoff.
+Suppressed: one monotonic read, inline disable.
+Negatives: the manifest's ``wall_ts`` epoch anchor (ts-name binding)
+and the caller-supplied ``now`` idiom itself.
+"""
+import time
+
+
+class SpanLog:
+    def __init__(self):
+        self.spans = []
+        self.wall_ts = 0.0
+
+    def add_span_bad(self, name):
+        self.spans.append({"name": name, "ts": time.monotonic()})  # expect: GL007
+
+    def flush_bad(self):
+        time.sleep(0.01)  # expect: GL007
+
+    def probe_suppressed(self):
+        return time.monotonic()  # graftlint: disable=GL007
+
+    def stamp_manifest(self):
+        # clean: the dump's epoch anchor is record data, not scheduling
+        self.wall_ts = time.time()
+
+    def add_span(self, name, now, dur_s):
+        # clean: the caller injects the clock reading (the contract)
+        self.spans.append({"name": name, "ts": now, "dur_s": dur_s})

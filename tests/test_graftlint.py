@@ -247,6 +247,26 @@ def test_zero_module_clean_under_jit_hazard_rules():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_tracing_modules_clean_under_clock_rule():
+    """ISSUE 10: telemetry/tracing.py takes every timestamp from the
+    caller (injected clock) and telemetry/flightrec.py's only wall read
+    is the ``wall_ts`` epoch anchor on dumps — both are in GL007 scope
+    (Config.clock_paths) and must stay clean outright, no suppressions.
+    This pins the contract the chaos gate's exact-duration trace
+    assertions rely on."""
+    paths = [
+        os.path.join(REPO, "mingpt_distributed_tpu", "telemetry", p)
+        for p in ("tracing.py", "flightrec.py")
+    ]
+    cfg = Engine(select=["GL007"], root=REPO).config
+    for p in paths:
+        rel = os.path.relpath(p, REPO)
+        assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL007"], root=REPO).run(paths)
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out

@@ -175,3 +175,84 @@ def test_multihost_pid_namespacing(tmp_path):
     assert pids == {"hostA:7", "hostB:7"}
     out = "\n".join(trace_summary.summarize(trace, top=5))
     assert "op_hostA" in out and "op_hostB" in out
+
+
+def _write_request_trace(path):
+    """A tiny hand-built mingpt-trace/1 stream: one clean request, one
+    retried request, one shed — the three shapes the renderer handles."""
+    from mingpt_distributed_tpu.telemetry import TraceRecorder, trace_sink
+
+    rec = TraceRecorder(sink=trace_sink(str(path)))
+    ctx = rec.start_trace("req-0", now=0.0)
+    rec.add_span(ctx, "serve.queue_wait", ts=0.0, dur_s=0.1)
+    rec.add_event(ctx, "emit", 0.2, token_index=0)
+    rec.add_event(ctx, "emit", 0.3, token_index=1)
+    rec.end_trace(ctx, now=0.3, outcome="length", n_tokens=2)
+
+    ctx = rec.start_trace("req-1", now=1.0)
+    a1 = rec.open_span(ctx, "fleet.attempt", 1.0, attempt=1,
+                       replica="replica0")
+    rec.close_span(a1, 1.1, outcome="crash")
+    rec.add_event(ctx, "retry", 1.1, reason="crash", attempt=1)
+    a2 = rec.open_span(ctx, "fleet.attempt", 1.2, attempt=2,
+                       replica="replica1")
+    rec.add_event(ctx, "emit", 1.3, token_index=0)
+    rec.close_span(a2, 1.4, outcome="length")
+    rec.end_trace(ctx, now=1.4, outcome="length", n_tokens=1, attempts=2)
+
+    ctx = rec.start_trace("shed-0", now=2.0)
+    rec.add_event(ctx, "shed", 2.0, reason="draining")
+    rec.end_trace(ctx, now=2.0, outcome="shed", n_tokens=0, attempts=0)
+    rec.close()
+
+
+def test_request_trace_timeline(tmp_path, capsys):
+    """A mingpt-trace/1 JSONL (ISSUE 10, serve.py --trace-jsonl) is
+    detected by schema and rendered as per-request timelines with
+    retries flagged — not pushed through the span-lane aggregation."""
+    p = tmp_path / "trace.jsonl"
+    _write_request_trace(p)
+    assert trace_summary.sniff_jsonl_schema(str(p)) == "mingpt-trace/1"
+    rc = trace_summary.main([str(p)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "request traces: 3" in out
+    assert "== req-0: outcome=length tokens=2" in out
+    assert "serve.queue_wait" in out and "emit x2" in out
+    # the retried request is flagged, with both attempts on the timeline
+    assert "== req-1: " in out and "RETRIED" in out
+    assert out.count("fleet.attempt") == 2
+    assert "RETRY retry reason=crash" in out
+    assert "== shed-0: outcome=shed" in out
+
+
+def test_request_trace_slo_mode(tmp_path, capsys):
+    p = tmp_path / "trace.jsonl"
+    _write_request_trace(p)
+    rc = trace_summary.main(
+        [str(p), "--slo", "ttft_p50<=1.0,shed_rate<=0.05"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SLO report" in out
+    assert "[ PASS ] ttft_p50" in out
+    assert "[ FAIL ] shed_rate" in out  # 1 of 3 requests shed
+
+
+def test_request_trace_invalid_stream_errors(tmp_path, capsys):
+    p = tmp_path / "bad.jsonl"
+    p.write_text(json.dumps({
+        "schema": "mingpt-trace/1", "kind": "span", "trace_id": "t",
+        "span_id": "s1", "parent_id": "s9", "name": "x", "ts": 0.0,
+        "dur_s": 1.0}) + "\n")
+    rc = trace_summary.main([str(p)])
+    assert rc == 1
+    assert "invalid mingpt-trace/1 stream" in capsys.readouterr().err
+
+
+def test_slo_flag_rejects_non_trace_input(tmp_path, capsys):
+    p = tmp_path / "spans.jsonl"
+    p.write_text('{"schema": "mingpt-telemetry/1", "kind": "span", '
+                 '"name": "train.step", "ts": 0.0, "dur_s": 1.0}\n')
+    rc = trace_summary.main([str(p), "--slo"])
+    assert rc == 1
+    assert "--slo needs a mingpt-trace/1" in capsys.readouterr().err
