@@ -1042,6 +1042,12 @@ def serving_probe() -> dict:
     block — exact-quantile TTFT/ITL/shed objectives graded by
     telemetry.slo — so BENCH rounds record SLO attainment alongside
     throughput.
+
+    ISSUE 11: a ``speculative`` block replays the same trace through a
+    draft/verify server (draft = target weights, accept rate 1.0) and
+    records tokens/sec vs the plain path, tokens-per-verify and the
+    verify-executable count — cpu_fallback compatible, token-exactness
+    asserted against the non-spec handles.
     """
     import jax
     import numpy as np
@@ -1064,7 +1070,7 @@ def serving_probe() -> dict:
     )
     rng = np.random.RandomState(0)
     shared = rng.randint(0, cfg.vocab_size, 48).tolist()
-    reqs = []
+    prompts = []
     for i in range(24):
         if i % 3 == 0:
             prompt = rng.randint(0, cfg.vocab_size, 100).tolist()
@@ -1072,12 +1078,45 @@ def serving_probe() -> dict:
             prompt = shared + rng.randint(0, cfg.vocab_size, 8).tolist()
         else:
             prompt = rng.randint(0, cfg.vocab_size, 12).tolist()
-        reqs.append(Request(prompt=prompt, max_new_tokens=16))
+        prompts.append(prompt)
+    reqs = [Request(prompt=p, max_new_tokens=16) for p in prompts]
     t0 = time.perf_counter()
     handles = server.generate_batch(reqs)
     wall = time.perf_counter() - t0
     m = server.summary()
     assert all(h.finished for h in handles)
+
+    # speculative block (ISSUE 11): the SAME 24-request trace through a
+    # second server with draft/verify decoding. Draft = the target's own
+    # weights, so acceptance is deterministic (rate 1.0) on every backend
+    # — the block measures the propose→verify machinery's throughput
+    # against the plain path, not draft quality. Token-exactness is
+    # asserted request-by-request against the non-spec run.
+    spec_k = 3
+    spec_server = InferenceServer(
+        params, cfg, n_slots=4, prefill_buckets=(16, 32, 64, 128),
+        prefill_chunk=32, prefix_cache_mb=16.0, warmup=True,
+        draft_params=params, draft_cfg=cfg, spec_k=spec_k,
+    )
+    spec_reqs = [Request(prompt=p, max_new_tokens=16) for p in prompts]
+    t0 = time.perf_counter()
+    spec_handles = spec_server.generate_batch(spec_reqs)
+    spec_wall = time.perf_counter() - t0
+    sm = spec_server.metrics
+    assert [h.tokens for h in spec_handles] == [h.tokens for h in handles], \
+        "speculative decode diverged from the plain greedy path"
+    spec_tps = sm.tokens_generated / spec_wall
+    speculative = {
+        "spec_k": spec_k,
+        "tokens_per_sec": round(spec_tps, 1),
+        "nonspec_tokens_per_sec": round(m["tokens_generated"] / wall, 1),
+        "speedup_vs_nonspec": round(
+            spec_tps / (m["tokens_generated"] / wall), 3),
+        "accept_rate": round(sm.spec_accept_rate, 3),
+        "tokens_per_verify_mean": round(sm.spec_tokens_per_verify_mean, 3),
+        "verify_rounds": sm.spec_rounds,
+        "verify_executables": spec_server.compile_counts()["verify"],
+    }
 
     eng = server.engine
     key = jax.random.key(1)
@@ -1111,6 +1150,7 @@ def serving_probe() -> dict:
         "prefill_full_window_ms": round(full_ms, 2),
         "prefill_prefix_tail_ms": round(tail_ms, 2),
         "short_vs_full_speedup": round(full_ms / short_ms, 2),
+        "speculative": speculative,
         "slo": slo,
     }
 

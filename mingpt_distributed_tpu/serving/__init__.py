@@ -24,6 +24,12 @@ what a server needs on top of it:
   utilization, per-request TTFT and inter-token latency; periodic log line
   plus a JSON summary, sharing the RateWindow plumbing of
   training/metrics.py.
+* ``SpeculativeDecoder`` / ``DraftEngine`` (speculative.py) — draft/verify
+  speculative decoding: a small-config draft model (slot pool mirrored
+  1:1 with the target's) proposes k tokens, ONE lifetime-compiled verify
+  program scores all k+1 rows in a single batched target forward, and the
+  scheduler emits the longest matching prefix plus a bonus token —
+  multiple tokens per round, token-exact with the plain greedy path.
 * ``Router`` / ``ReplicaSupervisor`` (fleet.py) — the resilient
   multi-replica layer: supervised in-process replicas with health-gated
   prefix-affinity routing, per-replica circuit breakers, bounded
@@ -56,10 +62,15 @@ from mingpt_distributed_tpu.serving.requests import (
     ShedError,
 )
 from mingpt_distributed_tpu.serving.scheduler import InferenceServer, SlotTable
+from mingpt_distributed_tpu.serving.speculative import (
+    DraftEngine,
+    SpeculativeDecoder,
+)
 
 __all__ = [
     "CircuitBreaker",
     "DecodeEngine",
+    "DraftEngine",
     "FleetHandle",
     "InferenceServer",
     "PrefixKVStore",
@@ -73,6 +84,7 @@ __all__ = [
     "ShedError",
     "SlotKVPool",
     "SlotTable",
+    "SpeculativeDecoder",
     "VirtualClock",
     "WallClock",
     "default_server_factory",

@@ -176,6 +176,11 @@ class RequestHandle:
     # events + end_trace — under a router, the router owns both
     trace: Optional["TraceContext"] = None
     trace_owner: bool = False
+    # speculative decoding (serving/speculative.py): draft tokens this
+    # request was offered / accepted across its verify rounds — the
+    # per-request accept-rate the trace summary reports
+    spec_proposed: int = 0
+    spec_accepted: int = 0
 
     @property
     def ttft_s(self) -> Optional[float]:

@@ -18,7 +18,11 @@ The loop the server runs (``step()`` = one scheduling round):
    one token (per-slot positions and sampling params; prefilling and
    free lanes ride along parked at position block_size-1, a row the
    stale-row invariant makes unobservable until its legitimate writer
-   fills it).
+   fills it). With speculation on (``draft_params`` + ``spec_k``,
+   serving/speculative.py), eligible greedy lanes instead run
+   propose→verify→accept-n and emit a burst of 1..k+1 tokens per round
+   — every one of them still the target model's own greedy choice, so
+   parity, retry idempotence and token-index dedup are untouched.
 4. **Retire** — requests hitting a stop condition (per-request
    ``max_new_tokens`` or EOS token) finish, free their slot, and the next
    round's admissions reuse it. Mid-decode admission is the whole point:
@@ -87,6 +91,7 @@ from mingpt_distributed_tpu.serving.requests import (  # noqa: F401  (re-export)
     RequestHandle,
     ShedError,
 )
+from mingpt_distributed_tpu.serving.speculative import SpeculativeDecoder
 from mingpt_distributed_tpu.telemetry import (
     MetricsRegistry,
     RecompileWatchdog,
@@ -197,6 +202,9 @@ class InferenceServer:
         strict_window: bool = False,
         fault_hook: Optional[Callable[[str], None]] = None,
         trace_recorder: Optional[TraceRecorder] = None,
+        draft_params=None,
+        draft_cfg: Optional[GPTConfig] = None,
+        spec_k: int = 0,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(
@@ -204,15 +212,33 @@ class InferenceServer:
             prefill_buckets=prefill_buckets, prefill_chunk=prefill_chunk,
             prefix_cache_mb=prefix_cache_mb,
         )
+        # speculative decoding (serving/speculative.py): a draft model +
+        # spec_k >= 1 turn the decode round into propose→verify→accept-n.
+        # Off by default — with it off the decode round is byte-identical
+        # to the plain path (compile_counts reports no spec families).
+        if draft_params is not None:
+            if draft_cfg is None:
+                raise ValueError("draft_params given without draft_cfg")
+            if spec_k < 1:
+                raise ValueError(
+                    "draft model given but spec_k < 1: pass spec_k >= 1 "
+                    "to enable speculation (or drop the draft)")
+            self.spec: Optional[SpeculativeDecoder] = SpeculativeDecoder(
+                self.engine, draft_params, draft_cfg, spec_k)
+        elif spec_k >= 1:
+            raise ValueError("spec_k >= 1 requires draft_params/draft_cfg")
+        else:
+            self.spec = None
         self.metrics = metrics or ServingMetrics(
             n_slots, log_every=log_every, registry=registry)
         # disabled-by-default tracer: span() returns a shared no-op, so the
         # scheduling loop pays nothing unless telemetry is wired in
         self.tracer = tracer if tracer is not None else SpanTracer(enabled=False)
-        # post-warmup recompile watchdog over the engine's compiled program
-        # families (armed after warmup(); checked every scheduling round)
+        # post-warmup recompile watchdog over the compiled program families
+        # (the merged server-level counts, so draft/verify traces are
+        # watched too; armed after warmup(); checked every round)
         self.watchdog = RecompileWatchdog(
-            self.engine.compile_counts,
+            self.compile_counts,
             registry=self.metrics.registry if registry is None else registry,
             tracer=self.tracer,
             hard_fail=recompile_fail,
@@ -243,6 +269,8 @@ class InferenceServer:
         self._ids = itertools.count()
         if warmup:
             self.engine.warmup()
+            if self.spec is not None:
+                self.spec.warmup()
             self.watchdog.arm()
 
     # -- submission ----------------------------------------------------
@@ -350,6 +378,8 @@ class InferenceServer:
             handle.prefilling = False
             self.slots.release(slot)
             self.engine.pool.free(slot)
+            if self.spec is not None:
+                self.spec.release(slot)
 
     def _retire(self, handle: RequestHandle) -> None:
         assert handle.slot is not None
@@ -362,10 +392,16 @@ class InferenceServer:
     def _end_owned_trace(self, handle: RequestHandle) -> None:
         if (self.trace_recorder is not None and handle.trace is not None
                 and handle.trace_owner):
+            extra: Dict[str, Any] = {}
+            if self.spec is not None:
+                # per-request speculation outcome rides the summary dict:
+                # accept-rate = spec_accepted / spec_proposed
+                extra = dict(spec_proposed=handle.spec_proposed,
+                             spec_accepted=handle.spec_accepted)
             self.trace_recorder.end_trace(
                 handle.trace, now=self.clock(),
                 outcome=handle.finish_reason or "error",
-                n_tokens=len(handle.tokens), attempts=1)
+                n_tokens=len(handle.tokens), attempts=1, **extra)
 
     def _fail(self, handle: RequestHandle, reason: str) -> None:
         """Terminal non-success: deadline expiry (queued, mid-prefill or
@@ -393,6 +429,10 @@ class InferenceServer:
         for long ones."""
         slot = self.engine.pool.allocate()
         assert slot is not None
+        if self.spec is not None:
+            # mirrored draft lane: both pools allocate lowest-free-index
+            # and free together, so the indices coincide (bind asserts it)
+            self.spec.bind(slot)
         handle.prefilling = True
         handle.admit_time = self.clock()
         rec = self.trace_recorder
@@ -453,6 +493,11 @@ class InferenceServer:
         handle.prefilling = False
         if self.engine.prefix_store is not None:
             self.engine.save_prefix(slot, prompt)
+        if self.spec is not None:
+            # one-shot draft prefill of the full prompt: draft state only
+            # shapes proposal quality, so it skips chunking/prefix reuse
+            self.spec.prime(
+                slot, prompt, jax.random.fold_in(self.slots.req_keys[slot], 0))
         ok = self._emit(handle, tok)
         now = self.clock()
         self.metrics.on_prefill(
@@ -500,41 +545,116 @@ class InferenceServer:
         if active:
             with self.tracer.span("serve.decode_round", lanes=len(active)):
                 td0 = self.clock()
-                for s in active:
-                    self.slots.fold_key(s, len(self.slots.handles[s].tokens))
                 st = self.slots
-                nxt = self.engine.decode_step(
-                    st.tokens, st.positions, st.temps, st.top_ks,
-                    st.top_ps, st.do_sample, st.stacked_keys(),
-                )
-                # per-request decode-round spans cover the shared
-                # compiled step and are recorded BEFORE emission: a
-                # retiring emit ends its (solo-owned) trace, and a
-                # later-arriving span would be dropped as an orphan
+                for s in active:
+                    st.fold_key(s, len(st.handles[s].tokens))
+                # speculation split: greedy lanes with k+1 rows of window
+                # headroom run propose→verify→accept-n; sampled lanes and
+                # near-window tails keep the plain one-token step (parity
+                # and key-folding semantics unchanged on both paths)
+                spec_slots: List[int] = []
+                if self.spec is not None:
+                    spec_slots = [s for s in active if self.spec.eligible(
+                        bool(st.do_sample[s]), int(st.positions[s]))]
+                plain = [s for s in active if s not in spec_slots]
+                burst: Dict[int, List[int]] = {}
+                if plain:
+                    if spec_slots:
+                        # park speculating lanes: the verify program is
+                        # their row-writer this round
+                        pmask = np.zeros(st.n_slots, bool)
+                        pmask[plain] = True
+                        pos = np.where(pmask, st.positions, st.parked)
+                        nxt = self.engine.decode_step(
+                            st.tokens, pos, st.temps, st.top_ks,
+                            st.top_ps, st.do_sample, st.stacked_keys(),
+                        )
+                    else:
+                        nxt = self.engine.decode_step(
+                            st.tokens, st.positions, st.temps, st.top_ks,
+                            st.top_ps, st.do_sample, st.stacked_keys(),
+                        )
+                    for s in plain:
+                        burst[s] = [int(nxt[s])]
+                if spec_slots:
+                    smask = np.zeros(st.n_slots, bool)
+                    smask[spec_slots] = True
+                    proposals = self.spec.propose(
+                        st.tokens, st.positions, smask, st.stacked_keys())
+                    fill_mask = np.zeros(st.n_slots, bool)
+                    fill_toks = np.zeros(st.n_slots, np.int32)
+                    fill_pos = np.zeros(st.n_slots, np.int32)
+                    for s in spec_slots:
+                        rows = [int(st.tokens[s])] + \
+                            [int(t) for t in proposals[s]]
+                        g = self.spec.verify(
+                            s, rows, int(st.positions[s]),
+                            float(st.temps[s]), int(st.top_ks[s]),
+                            float(st.top_ps[s]), st.keys[s])
+                        n_acc = self.spec.accept_len(proposals[s], g)
+                        burst[s] = [int(t) for t in g[:n_acc]]
+                        if n_acc == self.spec.k + 1:
+                            # full acceptance: the draft row pos+k was
+                            # never written — backfill d_k there so the
+                            # next propose round attends a real row
+                            fill_mask[s] = True
+                            fill_toks[s] = int(proposals[s][-1])
+                            fill_pos[s] = int(st.positions[s]) + self.spec.k
+                    self.spec.backfill(
+                        fill_toks, fill_pos, fill_mask, st.stacked_keys())
+                # per-request decode-round spans cover the compiled
+                # step(s) and are recorded BEFORE emission: a retiring
+                # emit ends its (solo-owned) trace, and a later-arriving
+                # span would be dropped as an orphan
                 if self.trace_recorder is not None:
                     td1 = self.clock()
                     for s in active:
                         h = st.handles[s]
-                        if h.trace is not None:
+                        if h.trace is None:
+                            continue
+                        if s in spec_slots:
+                            self.trace_recorder.add_span(
+                                h.trace, "serve.spec_round", ts=td0,
+                                dur_s=td1 - td0, lanes=len(active),
+                                proposed=self.spec.k,
+                                accepted=len(burst[s]) - 1,
+                                request_id=h.request_id)
+                        else:
                             self.trace_recorder.add_span(
                                 h.trace, "serve.decode_round", ts=td0,
                                 dur_s=td1 - td0, lanes=len(active),
                                 request_id=h.request_id)
                 # chaos fault point: a raise here loses this round's
-                # computed tokens before any of them is emitted — the
-                # crash-mid-decode case the fleet retry must survive
-                # without double-emission
+                # computed tokens (the whole accepted burst included)
+                # before any of them is emitted — the crash-mid-decode
+                # case the fleet retry must survive without double-
+                # emission
                 self._fire_fault("decode_round")
                 for s in active:
                     handle = st.handles[s]
-                    token = int(nxt[s])
-                    ok = self._emit(handle, token)
-                    st.tokens[s] = token
-                    st.positions[s] += 1
-                    if not ok:
-                        self._fail(handle, "error")
-                    elif self._check_stop(handle, token):
-                        self._retire(handle)
+                    toks = burst[s]
+                    if s in spec_slots:
+                        handle.spec_proposed += self.spec.k
+                        handle.spec_accepted += len(toks) - 1
+                        self.metrics.on_spec_round(self.spec.k, len(toks))
+                    for token in toks:
+                        ok = self._emit(handle, token)
+                        st.tokens[s] = token
+                        st.positions[s] += 1
+                        if not ok:
+                            self._fail(handle, "error")
+                            break
+                        if self._check_stop(handle, token):
+                            self._retire(handle)
+                            break
+                        # mid-burst deadline: a burst is the new round
+                        # granularity, so expiry is enforced between
+                        # tokens too — the tail of the burst is dropped
+                        # and both the target and draft slots free now
+                        if (handle.deadline is not None
+                                and self.clock() >= handle.deadline):
+                            self._fail(handle, "deadline")
+                            break
 
         occupied = self.slots.occupied
         self.metrics.on_step(len(self.queue), occupied, lanes_used=len(active))
@@ -567,7 +687,13 @@ class InferenceServer:
         return handles
 
     def compile_counts(self) -> Dict[str, int]:
-        return self.engine.compile_counts()
+        """Engine program families, plus the verify/draft families when
+        speculation is on (absent otherwise, so the plain server's counts
+        are unchanged by this feature existing)."""
+        counts = self.engine.compile_counts()
+        if self.spec is not None:
+            counts.update(self.spec.compile_counts())
+        return counts
 
     def summary(self) -> Dict[str, Any]:
         return self.metrics.summary()

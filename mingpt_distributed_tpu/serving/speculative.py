@@ -1,0 +1,296 @@
+"""Speculative decoding: draft/verify with a single batched verify program.
+
+The plain decode round emits exactly one token per compiled step per
+slot, so tokens/sec is bounded by per-step latency. Speculation breaks
+that bound without changing a single emitted token:
+
+* a **DraftEngine** — a small-config GPT with its own ``SlotKVPool``
+  whose slot indices mirror the target's 1:1 — proposes ``k`` tokens
+  autoregressively (k batched draft decode steps over every speculating
+  lane at once), and
+* ONE lifetime-compiled **verify program** on the target model scores
+  all ``k+1`` positions in a single batched forward against the slot's
+  cache lane. The program is the prefill-at-offset body from
+  ``engine.py`` with a fixed ``k+1``-row chunk and logits read at every
+  row instead of just the last — offset/slot are traced scalars and the
+  row count is static, so the verify family is exactly one executable
+  per (k, engine) for the server's lifetime (asserted through
+  ``compile_counts()``).
+
+Acceptance is greedy longest-matching-prefix: feeding
+``[cur, d_1..d_k]`` at positions ``pos..pos+k`` yields the target's own
+next-token choice ``g_j`` at every row; proposals are accepted while
+``d_{j+1} == g_j``, and ``g_{n_acc-1}`` rides along as the bonus token,
+so every emitted token is the target's own greedy choice — token-exact
+parity with the non-speculative path by construction, and at least one
+token per verify even when the draft is useless.
+
+**Rollback is free.** Rejected rows on both engines are simply left in
+place: the stale-row invariant (a cache row is visible only once a
+query position reaches it, and every writer fills a row before its
+first reader) means the next verify/decode at ``pos+n_acc`` rewrites
+them before anything attends that far. The only write speculation adds
+is the draft **backfill** step on full acceptance — one extra batched
+draft decode feeding ``d_k`` at ``pos+k`` so the draft row the *next*
+propose round's queries attend is real, not stale.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GPTConfig
+from ..models import generate as gen
+from .engine import (
+    DecodeEngine,
+    _install_lane,
+    _select_next_slots,
+    _slot_lane,
+)
+
+__all__ = ["DraftEngine", "SpeculativeDecoder"]
+
+
+def _verify_impl(
+    params, cache, tokens, offset, slot, temp, top_k, top_p, key,
+    *, cfg: GPTConfig,
+):
+    """Score ``tokens`` (rows = k+1, static) at absolute positions
+    ``offset..offset+rows-1`` against one slot lane and return the
+    target's next-token choice at EVERY row. The sampler is
+    ``_select_next_slots`` with the slot's own (greedy) parameters — not
+    a raw argmax — so fp tie-breaking is bit-identical to the plain
+    decode path and parity holds even on tied logits."""
+    rows = tokens.shape[0]
+    lane = _slot_lane(cache, slot)
+    x, lane = gen._forward_cached_hidden(params, tokens[None], lane, offset, cfg)
+    logits = gen._head_logits(params, x, cfg)[0]  # (rows, V) fp32
+    keys = jax.random.split(key, rows)
+    nxt = _select_next_slots(
+        logits, keys,
+        jnp.full((rows,), temp, jnp.float32),
+        jnp.full((rows,), top_k, jnp.int32),
+        jnp.full((rows,), top_p, jnp.float32),
+        jnp.zeros((rows,), bool),
+    )
+    return nxt, _install_lane(cache, lane, slot)
+
+
+class DraftEngine:
+    """The proposal model: a ``DecodeEngine`` over the draft params whose
+    slot pool mirrors the target's slot indices 1:1.
+
+    Mirroring works because both pools allocate lowest-free-index and
+    this wrapper binds/frees in lockstep with the target — ``bind``
+    asserts the indices actually coincide, so a drifted mirror fails
+    loudly instead of silently attending the wrong lane. Draft state is
+    advisory (it only shapes proposal quality, never emitted tokens), so
+    the draft prefill is one un-chunked shot with no prefix store."""
+
+    def __init__(
+        self,
+        params,
+        cfg: GPTConfig,
+        target: DecodeEngine,
+    ):
+        if cfg.vocab_size != target.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab {cfg.vocab_size} != target "
+                f"{target.cfg.vocab_size}")
+        if cfg.block_size < target.cfg.block_size:
+            raise ValueError(
+                f"draft block_size {cfg.block_size} < target "
+                f"{target.cfg.block_size}: draft must cover the window")
+        self.engine = DecodeEngine(
+            params, cfg, target.n_slots,
+            prefill_len=target.prefill_len,
+            prefill_buckets=target.buckets,
+        )
+
+    def bind(self, slot: int) -> None:
+        got = self.engine.pool.allocate()
+        if got != slot:
+            self.engine.pool.free(got)
+            raise RuntimeError(
+                f"draft/target slot mirror broken: target gave {slot}, "
+                f"draft gave {got}")
+
+    def release(self, slot: int) -> None:
+        self.engine.pool.free(slot)
+
+    def prime(self, slot: int, prompt_ids: Sequence[int], key) -> None:
+        """Prefill the draft lane with the full prompt in one call (the
+        ladder always covers prefill_len, so one bucket suffices)."""
+        self.engine.prefill_chunk_call(
+            slot, list(prompt_ids), 0, 1.0, None, None, False, key)
+
+
+class SpeculativeDecoder:
+    """propose -> verify -> accept-n for the scheduler's decode round.
+
+    Owns the draft engine and the single verify jit. The scheduler calls
+    ``bind``/``release`` in lockstep with the target pool, ``prime`` at
+    end-of-prefill, and per round: ``propose`` (k batched draft steps),
+    ``verify`` per speculating slot, ``accept`` for the matching-prefix
+    length, then ``backfill`` for fully-accepted slots."""
+
+    def __init__(
+        self,
+        target: DecodeEngine,
+        draft_params,
+        draft_cfg: GPTConfig,
+        k: int,
+    ):
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        if k + 1 > target.cfg.block_size:
+            raise ValueError(
+                f"spec_k {k} leaves no room for the bonus row in a "
+                f"{target.cfg.block_size}-position window")
+        self.target = target
+        self.k = k
+        self.rows = k + 1
+        self.draft = DraftEngine(draft_params, draft_cfg, target)
+        self._parked = target.cfg.block_size - 1
+        self._verify_jit = jax.jit(
+            functools.partial(_verify_impl, cfg=target.cfg),
+            donate_argnums=(1,))
+
+    # -- slot lifecycle (mirrors the target pool) ----------------------
+    def bind(self, slot: int) -> None:
+        self.draft.bind(slot)
+
+    def release(self, slot: int) -> None:
+        self.draft.release(slot)
+
+    def prime(self, slot: int, prompt_ids: Sequence[int], key) -> None:
+        self.draft.prime(slot, prompt_ids, key)
+
+    # -- eligibility ---------------------------------------------------
+    def eligible(self, do_sample: bool, position: int) -> bool:
+        """A lane speculates only when greedy (sampled lanes keep the
+        plain path's per-token key-folding semantics) and when all k+1
+        verify rows fit inside the cache window; near-window tails fall
+        back to the plain decode step, preserving parity."""
+        return (not do_sample) and position + self.rows <= \
+            self.target.cfg.block_size
+
+    # -- the round -----------------------------------------------------
+    def propose(
+        self,
+        tokens: np.ndarray,      # (S,) last emitted token per slot
+        positions: np.ndarray,   # (S,) its absolute position
+        spec_mask: np.ndarray,   # (S,) bool, lanes speculating this round
+        keys,                    # (S,) typed keys (unused: greedy draft)
+    ) -> np.ndarray:
+        """k greedy draft decode steps over every speculating lane at
+        once; non-speculating lanes ride along parked (their draft rows
+        at block_size-1 go stale, never read). Returns (S, k) proposals;
+        rows where ``spec_mask`` is False are meaningless."""
+        s = len(tokens)
+        toks = np.where(spec_mask, tokens, 0).astype(np.int32)
+        pos = np.where(spec_mask, positions, self._parked).astype(np.int32)
+        ones_f = np.ones(s, np.float32)
+        zeros_i = np.zeros(s, np.int32)
+        greedy = np.zeros(s, bool)
+        out = np.zeros((s, self.k), np.int32)
+        for j in range(self.k):
+            nxt = self.draft.engine.decode_step(
+                toks, pos, ones_f, zeros_i, ones_f, greedy, keys)
+            out[:, j] = nxt
+            toks = np.where(spec_mask, nxt, 0).astype(np.int32)
+            pos = np.where(spec_mask, pos + 1, self._parked).astype(np.int32)
+        return out
+
+    def verify(
+        self,
+        slot: int,
+        row_tokens: Sequence[int],   # [cur, d_1..d_k] — exactly k+1 rows
+        offset: int,
+        temperature: float,
+        top_k: Optional[int],
+        top_p: Optional[float],
+        key,
+    ) -> np.ndarray:
+        """One batched target forward over the k+1 rows at
+        ``offset..offset+k``; returns the target's greedy choice at every
+        row (the cache lane keeps all k+1 written rows — rejected ones
+        become stale)."""
+        if len(row_tokens) != self.rows:
+            raise ValueError(
+                f"verify expects {self.rows} rows, got {len(row_tokens)}")
+        if offset + self.rows > self.target.cfg.block_size:
+            raise ValueError(
+                f"verify rows at offset {offset} overrun the "
+                f"{self.target.cfg.block_size} cache window (the scheduler "
+                "gates eligibility on window headroom)")
+        nxt, cache = self._verify_jit(
+            self.target.params, self.target.pool.cache,
+            jnp.asarray(np.asarray(row_tokens, np.int32)),
+            np.int32(offset), np.int32(slot),
+            np.float32(temperature),
+            np.int32(0 if top_k is None else top_k),
+            np.float32(1.0 if top_p is None else top_p),
+            key,
+        )
+        self.target.pool.cache = cache
+        return np.asarray(jax.device_get(nxt))
+
+    def accept_len(self, proposals: np.ndarray, greedy: np.ndarray) -> int:
+        """Longest matching prefix + 1: tokens emitted this round are
+        ``greedy[:n_acc]`` — always >= 1 (the bonus token) and all the
+        target's own choices."""
+        n_acc = 1
+        while n_acc <= self.k and int(proposals[n_acc - 1]) == \
+                int(greedy[n_acc - 1]):
+            n_acc += 1
+        return n_acc
+
+    def backfill(
+        self,
+        tokens: np.ndarray,      # (S,) d_k per fully-accepted slot
+        positions: np.ndarray,   # (S,) pos + k for those slots
+        fill_mask: np.ndarray,   # (S,) bool, fully-accepted lanes
+        keys,
+    ) -> None:
+        """On full acceptance the draft cache's row ``pos+k`` was never
+        written (the k-th draft step read it as a query input, not a
+        write target), but the next propose round's queries will attend
+        it — run one extra batched draft step feeding ``d_k`` there so
+        the row is real. Skipped entirely when no lane fully accepted."""
+        if not fill_mask.any():
+            return
+        s = len(tokens)
+        toks = np.where(fill_mask, tokens, 0).astype(np.int32)
+        pos = np.where(fill_mask, positions, self._parked).astype(np.int32)
+        self.draft.engine.decode_step(
+            toks, pos, np.ones(s, np.float32), np.zeros(s, np.int32),
+            np.ones(s, np.float32), np.zeros(s, bool), keys)
+
+    # -- warmup / accounting -------------------------------------------
+    def warmup(self) -> None:
+        """Trace the draft family (ladder + decode) and the verify
+        program. Scribbles slot 0 rows on both engines — harmless under
+        the stale-row invariant, but both pools must be empty."""
+        assert self.target.pool.used_count == 0, \
+            "spec warmup requires an empty target pool"
+        self.draft.engine.warmup()
+        key = jax.random.key(0)
+        self.verify(0, [0] * self.rows, 0, 1.0, None, None, key)
+
+    def compile_counts(self) -> Dict[str, int]:
+        """Speculation's program families: verify stays at 1 for the
+        server's lifetime (fixed row count, traced offset/slot); draft
+        prefill <= len(ladder), draft decode 1."""
+        draft = self.draft.engine.compile_counts()
+        return {
+            "verify": self._verify_jit._cache_size(),
+            "draft_prefill": draft["prefill"],
+            "draft_decode": draft["decode"],
+        }

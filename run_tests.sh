@@ -77,6 +77,18 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
         --prefill-buckets 4,6,8,16,32,48 --prefix-cache-mb 4 --warmup \
         --metrics-port 0
 
+# Speculative-decoding gate (ISSUE 11): draft/verify serving must be
+# token-exact with the plain greedy path. Variant A (draft == target)
+# demands accept rate 1.0 and k+1 tokens per verify; variant B (the
+# target's first layer as the draft, composed with chunked prefill +
+# prefix reuse) exercises real rejections and cache rollback. Both
+# assert ONE verify executable for the server's lifetime and zero
+# post-warmup recompiles.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-spec --spec-k 3
+
 # Durability gate: fault-injected checkpoint save/restore roundtrip on a
 # tmpdir — every 3rd write fails transiently (retries must absorb it) and
 # the latest blob is truncated (restore must fall back to the previous

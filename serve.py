@@ -133,6 +133,23 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="pre-trace the prefill bucket ladder and decode "
                         "step before serving (no first-request compile "
                         "stall)")
+    p.add_argument("--draft-config", default=None,
+                   help="speculative decoding draft model: 'self' (draft "
+                        "= target weights), 'self:N' (first N layers of "
+                        "the target), or comma-separated GPTConfig "
+                        "overrides like 'n_layer=2,n_embd=64' (random "
+                        "init); requires --spec-k >= 1")
+    p.add_argument("--spec-k", type=int, default=0,
+                   help="draft tokens proposed per verify round (0 = "
+                        "speculation off); eligible greedy lanes then "
+                        "emit 1..k+1 tokens per round, token-exact with "
+                        "the plain greedy path")
+    p.add_argument("--selftest-spec", action="store_true",
+                   help="random-init tiny model: speculative decode must "
+                        "be token-identical to the plain greedy path "
+                        "(identical-draft and truncated-draft variants, "
+                        "incl. chunked prefill + prefix reuse) with O(1) "
+                        "verify executables; exits non-zero on mismatch")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus /metrics + /healthz on this port "
                         "(0 = ephemeral port, printed at start); default: "
@@ -223,6 +240,65 @@ def _server_kwargs(args) -> dict:
         prefix_cache_mb=args.prefix_cache_mb,
         warmup=args.warmup,
     )
+
+
+def _draft_from(spec, params, cfg):
+    """Resolve --draft-config into (draft_params, draft_cfg).
+
+    'self' shares the target weights outright (accept rate 1.0 — the
+    plumbing-proof configuration); 'self:N' takes the first N layers of
+    the target (blocks are stacked on a leading layer axis, so the draft
+    is a prefix-slice sharing embeddings/head); 'k=v,...' builds a
+    separate random-init config off the target's dims."""
+    import jax
+
+    from mingpt_distributed_tpu.models import gpt
+
+    if spec == "self":
+        return params, cfg
+    if spec.startswith("self:"):
+        try:
+            n = int(spec.split(":", 1)[1])
+        except ValueError:
+            raise SystemExit(f"--draft-config self:N needs an int, "
+                             f"got {spec!r}")
+        if not 1 <= n <= cfg.n_layer:
+            raise SystemExit(f"--draft-config {spec!r}: N outside "
+                             f"[1, {cfg.n_layer}]")
+        dcfg = dataclasses.replace(cfg, n_layer=n)
+        dparams = dict(params)
+        dparams["blocks"] = jax.tree.map(lambda a: a[:n], params["blocks"])
+        return dparams, dcfg
+    overrides = {}
+    for clause in spec.split(","):
+        k, sep, v = clause.partition("=")
+        if not sep or not k.strip():
+            raise SystemExit(f"--draft-config clause {clause!r} is not "
+                             f"k=v (or 'self' / 'self:N')")
+        try:
+            overrides[k.strip()] = int(v)
+        except ValueError:
+            try:
+                overrides[k.strip()] = float(v)
+            except ValueError:
+                overrides[k.strip()] = v.strip()
+    try:
+        dcfg = dataclasses.replace(cfg, **overrides).resolved()
+    except Exception as e:
+        raise SystemExit(f"--draft-config {spec!r}: {e}")
+    return gpt.init(jax.random.key(1), dcfg), dcfg
+
+
+def _spec_kwargs(args, params, cfg) -> dict:
+    """Speculative-decoding kwargs for InferenceServer (empty dict = off).
+    --draft-config and --spec-k only make sense together."""
+    if args.spec_k <= 0 and args.draft_config is None:
+        return {}
+    if args.spec_k <= 0 or args.draft_config is None:
+        raise SystemExit(
+            "--draft-config and --spec-k (>= 1) must be given together")
+    dparams, dcfg = _draft_from(args.draft_config, params, cfg)
+    return dict(draft_params=dparams, draft_cfg=dcfg, spec_k=args.spec_k)
 
 
 def _start_telemetry(args):
@@ -436,6 +512,136 @@ def _selftest_scrape(tserver) -> int:
         rc = 1
     n = len(parsed["samples"])
     print(f"selftest scrape: {n} samples, recompiles_total {recompiles:g}")
+    return rc
+
+
+def selftest_spec(args) -> int:
+    """ISSUE 11 acceptance gate: speculative decode must be token-exact
+    with the non-speculative greedy path, with ONE verify executable for
+    the server's lifetime.
+
+    Two variants run, both against solo generate():
+
+    * **identical draft** (``--draft-config self`` semantics): every
+      proposal matches, so acceptance is always k+1 — the full-burst
+      emission path, the draft backfill row, and the accept-rate/tokens-
+      per-verify metrics are all exercised at their ceiling (accept rate
+      must be exactly 1.0, tokens/verify exactly k+1);
+    * **truncated 1-layer draft + chunked prefill + prefix reuse**: real
+      rejections exercise cache rollback on both engines, combined with
+      6-token prefill chunks, a multi-bucket ladder and a shared-prefix
+      store hit — the combined-machinery parity the plain selftest runs
+      without speculation.
+
+    Both servers warm up and must show zero post-warmup recompiles with
+    the verify/draft families inside the watched counts."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import generate as gen
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import InferenceServer, Request
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    k = args.spec_k if args.spec_k > 0 else 3
+    max_new = 12
+
+    def solo(p):
+        return np.asarray(
+            gen.generate(params, cfg, jnp.asarray(p, jnp.int32)[None],
+                         max_new))[0, len(p):].tolist()
+
+    def check_parity(tag, canned, prompts, handles) -> int:
+        bad = 0
+        for text, p, h in zip(canned, prompts, handles):
+            want = solo(p)
+            ok = h.tokens == want
+            print(f"selftest-spec [{tag}] {h.request_id} ({text!r}): "
+                  + ("OK" if ok
+                     else f"MISMATCH spec={h.tokens} solo={want}"))
+            if not ok:
+                bad = 1
+        return bad
+
+    canned = ["O God, O God!", "Once more unto", "All the world's"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    rc = 0
+
+    # -- variant A: identical draft (always-accept ceiling) ------------
+    srv = InferenceServer(params, cfg, n_slots=2, warmup=True,
+                          draft_params=params, draft_cfg=cfg, spec_k=k)
+    handles = srv.generate_batch(
+        [Request(prompt=p, max_new_tokens=max_new) for p in prompts])
+    rc |= check_parity("self", canned, prompts, handles)
+    m = srv.metrics
+    if m.spec_accept_rate != 1.0:
+        print(f"selftest-spec FAIL: identical draft accept rate "
+              f"{m.spec_accept_rate} != 1.0")
+        rc = 1
+    if m.spec_tokens_per_verify_mean != k + 1:
+        print(f"selftest-spec FAIL: identical draft emitted "
+              f"{m.spec_tokens_per_verify_mean} tokens/verify, want {k + 1}")
+        rc = 1
+    counts = srv.compile_counts()
+    if counts["verify"] != 1 or counts["draft_decode"] != 1:
+        print(f"selftest-spec FAIL: unbounded speculation programs: "
+              f"{counts}")
+        rc = 1
+    if srv.watchdog.recompiles:
+        print(f"selftest-spec FAIL: {srv.watchdog.recompiles} post-warmup "
+              f"recompile(s) (spec families are watched)")
+        rc = 1
+    print(f"selftest-spec [self] accept "
+          f"{m.spec_accepted}/{m.spec_proposed}, "
+          f"tokens/verify {m.spec_tokens_per_verify_mean:.3g}, "
+          f"counts {counts}")
+
+    # -- variant B: truncated draft + chunked prefill + prefix reuse ---
+    dcfg = dataclasses.replace(cfg, n_layer=1)
+    dparams = dict(params)
+    dparams["blocks"] = jax.tree.map(lambda a: a[:1], params["blocks"])
+    canned_b = canned + ["Once more unto the breach",
+                         "Once more unto the wall!"]
+    prompts_b = [[ord(c) % cfg.vocab_size for c in s] for s in canned_b]
+    srv2 = InferenceServer(
+        params, cfg, n_slots=2, warmup=True,
+        prefill_chunk=6, prefill_buckets=(4, 6, 8, 16, 32, 48),
+        prefix_cache_mb=4.0,
+        draft_params=dparams, draft_cfg=dcfg, spec_k=k)
+    handles_b = srv2.generate_batch(
+        [Request(prompt=p, max_new_tokens=max_new) for p in prompts_b])
+    rc |= check_parity("self:1+chunk+prefix", canned_b, prompts_b, handles_b)
+    m2 = srv2.metrics
+    counts2 = srv2.compile_counts()
+    ladder = len(srv2.engine.buckets)
+    if counts2["verify"] != 1:
+        print(f"selftest-spec FAIL: verify family grew: {counts2}")
+        rc = 1
+    if counts2["prefill"] > ladder or counts2["draft_prefill"] > ladder:
+        print(f"selftest-spec FAIL: prefill families exceed the "
+              f"{ladder}-bucket ladder: {counts2}")
+        rc = 1
+    if m2.prefix_hits < 1:
+        print("selftest-spec FAIL: prefix store enabled but no hit")
+        rc = 1
+    if m2.spec_rounds < 1:
+        print("selftest-spec FAIL: no verify rounds ran in variant B")
+        rc = 1
+    if srv2.watchdog.recompiles:
+        print(f"selftest-spec FAIL: {srv2.watchdog.recompiles} post-warmup "
+              f"recompile(s) in the combined variant")
+        rc = 1
+    print(f"selftest-spec [self:1+chunk+prefix] accept "
+          f"{m2.spec_accepted}/{m2.spec_proposed}, "
+          f"prefix_hits {m2.prefix_hits}, counts {counts2}")
+    print("selftest-spec metrics:", json.dumps(srv2.summary()))
+    print("selftest-spec", "PASSED" if rc == 0 else "FAILED")
     return rc
 
 
@@ -750,6 +956,8 @@ def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     if args.selftest_chaos:
         return selftest_chaos(args)
+    if args.selftest_spec:
+        return selftest_spec(args)
     if args.selftest:
         return selftest(args)
 
@@ -799,6 +1007,7 @@ def main(argv=None) -> int:
     guard = _ShutdownGuard().install()
     reg, tserver = _start_telemetry(args)
     recorder, flight = _make_observability(args, reg)
+    spec_kw = _spec_kwargs(args, params, gpt_cfg)
     if tserver is not None and flight is not None:
         tserver.flight_provider = lambda: flight.snapshot("on_demand")
 
@@ -822,6 +1031,7 @@ def main(argv=None) -> int:
                     params, gpt_cfg, n_slots=args.slots,
                     max_queue=args.queue_limit,
                     default_deadline_s=args.deadline_s,
+                    **spec_kw,
                     **_server_kwargs(args)),
                 n_replicas=args.replicas,
                 clock=WallClock(),
@@ -842,6 +1052,7 @@ def main(argv=None) -> int:
                                  default_deadline_s=args.deadline_s,
                                  registry=reg,
                                  trace_recorder=recorder,
+                                 **spec_kw,
                                  **_server_kwargs(args))
         if flight is not None:
             server.watchdog.on_recompile = (

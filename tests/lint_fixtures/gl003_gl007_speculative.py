@@ -1,0 +1,55 @@
+"""GL003/GL007 fixtures — the hazards speculative decoding must avoid.
+
+The verify/accept round (serving/speculative.py) is a host-side loop
+around ONE jitted program: acceptance decisions happen on the host after
+``device_get`` (concrete ints), never as Python branches on traced
+values inside the verify body — per-round branching there would retrace
+per acceptance pattern — and burst deadlines come from the scheduler's
+injected clock, never the wall.
+
+Positives: a traced accept-branch and a data-dependent early-out inside
+jitted verify bodies; a wall-clock deadline read in the burst loop.
+Suppressed: one traced while-loop, inline disable.
+Negatives: host-side acceptance arithmetic on concrete ints; masked
+rollback via ``jnp.where``; the injected-clock deadline check.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def verify_branches_on_acceptance(proposals, greedy):
+    if proposals[0] == greedy[0]:  # expect: GL003
+        return proposals
+    return greedy
+
+
+@jax.jit
+def verify_early_out(logits, threshold):
+    best = jnp.max(logits)
+    while best < threshold:  # graftlint: disable=GL003
+        best = best + 1.0
+    return best
+
+
+@jax.jit
+def rollback_is_masked_not_branched(rows, n_acc, stale):
+    keep = jnp.arange(rows.shape[0]) < n_acc
+    return jnp.where(keep, rows, stale)  # clean: the masked-rollback idiom
+
+
+def host_accept_len(proposals, greedy):
+    n_acc = 1  # clean: host ints after device_get — branching is free here
+    while n_acc <= len(proposals) and proposals[n_acc - 1] == greedy[n_acc - 1]:
+        n_acc += 1
+    return n_acc
+
+
+def burst_deadline_bad(deadline):
+    return time.monotonic() >= deadline  # expect: GL007
+
+
+def burst_deadline_injected(clock, deadline):
+    return clock() >= deadline  # clean: the scheduler's injected clock

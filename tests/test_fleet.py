@@ -55,13 +55,15 @@ def solo_greedy(params, cfg, prompt, n):
 
 
 def make_fleet(cfg_params, n_replicas=2, spec=None, n_slots=2,
-               registry=None, **router_kw):
+               registry=None, factory_kwargs=None, **router_kw):
     """A small fleet on a virtual clock with fast backoffs, so every
-    retry/restart resolves within a few ticks."""
+    retry/restart resolves within a few ticks. ``factory_kwargs`` reach
+    every replica's InferenceServer (e.g. speculative-decoding knobs)."""
     cfg, params = cfg_params
     injector = ServingFaultInjector(spec) if spec is not None else None
     sup = ReplicaSupervisor(
-        default_server_factory(params, cfg, n_slots=n_slots),
+        default_server_factory(params, cfg, n_slots=n_slots,
+                               **(factory_kwargs or {})),
         n_replicas=n_replicas,
         clock=VirtualClock(tick_s=0.001),
         injector=injector,
@@ -228,6 +230,37 @@ def test_crash_mid_decode_retries_on_survivor(cfg_params):
         assert h.finish_reason == "length"
         assert h.tokens == solo_greedy(params, cfg, p, n)
         # the caller-visible stream saw every token exactly once
+        assert streamed[h.request_id] == h.tokens
+
+
+def test_crash_mid_decode_with_speculation_never_double_emits(cfg_params):
+    """Crash-retry composed with speculative decoding: the decode_round
+    fault point fires BEFORE any of a verify round's accepted burst is
+    emitted, so a crashed replica loses the whole burst and the
+    survivor's re-decode dedups by token index — multi-token bursts
+    widen the emission window but cannot double-emit."""
+    cfg, params = cfg_params
+    streamed = {}
+    # nth=2, not 3: bursts retire an 8-token request in ~3 decode rounds,
+    # so the crash must land while tokens are genuinely still in flight
+    router = make_fleet(
+        cfg_params, n_replicas=2, spec="crash:nth=2:match=replica0",
+        factory_kwargs=dict(draft_params=params, draft_cfg=cfg, spec_k=3))
+    router.on_token = lambda fh, tok: streamed.setdefault(
+        fh.request_id, []).append(tok)
+    n = 8
+    prompts = (prompts_with_affinity(router, 0, 2)
+               + prompts_with_affinity(router, 1, 2))
+    handles = router.generate_batch(
+        [Request(prompt=p, max_new_tokens=n) for p in prompts])
+    s = router.summary()
+    assert s["replicas"]["replica0"]["crashes"] == 1
+    assert s["retries_by_reason"]["crash"] >= 1
+    assert [h for h in handles if h.attempts > 1], "crash must force retry"
+    for p, h in zip(prompts, handles):
+        assert h.finish_reason == "length"
+        assert h.tokens == solo_greedy(params, cfg, p, n)
+        # every token streamed exactly once, even across the retry
         assert streamed[h.request_id] == h.tokens
 
 

@@ -267,6 +267,25 @@ def test_tracing_modules_clean_under_clock_rule():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_speculative_module_clean_under_recompile_and_clock_rules():
+    """ISSUE 11: serving/speculative.py's verify body runs under ONE
+    lifetime jit — a traced branch there (GL003) would retrace per
+    acceptance pattern, and a wall-clock read (GL007, the module is in
+    clock-discipline scope) would break the virtual-clock chaos tests
+    that cover mid-burst deadlines. Both must hold outright — no
+    suppressions, no baseline entries. The hazards and their approved
+    host-side/masked idioms are pinned by the
+    gl003_gl007_speculative.py fixture."""
+    path = os.path.join(
+        REPO, "mingpt_distributed_tpu", "serving", "speculative.py")
+    cfg = Engine(select=["GL003", "GL007"], root=REPO).config
+    rel = os.path.relpath(path, REPO)
+    assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL003", "GL007"], root=REPO).run([path])
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out

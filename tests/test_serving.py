@@ -11,6 +11,8 @@ The load-bearing guarantees:
 * the serving metrics counters add up.
 """
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -675,3 +677,227 @@ def test_deadline_expiry_mid_prefill_frees_slot_and_counts(cfg_params):
     h2 = server.submit(Request(prompt=PROMPTS[1], max_new_tokens=4))
     server.run_until_drained(max_steps=100)
     assert h2.tokens == solo_greedy(params, cfg, PROMPTS[1], 4)
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding (serving/speculative.py)
+# ---------------------------------------------------------------------------
+
+
+def truncated_draft(params, cfg, n_layer=1):
+    """A real small draft sharing the target's embeddings and head: the
+    target's first ``n_layer`` stacked transformer blocks (serve.py's
+    ``--draft-config self:N``)."""
+    dcfg = dataclasses.replace(cfg, n_layer=n_layer)
+    dparams = dict(params)
+    dparams["blocks"] = jax.tree.map(lambda a: a[:n_layer], params["blocks"])
+    return dparams, dcfg
+
+
+def test_spec_identical_draft_parity_and_one_verify_trace(cfg_params):
+    """Draft == target: every proposal is accepted, every burst is k+1
+    tokens, output stays token-exact with solo generate(), and the whole
+    run costs exactly ONE verify trace and ONE draft decode trace —
+    speculation's compile count is O(1), not O(requests) or O(position)."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=3, warmup=True,
+                             draft_params=params, draft_cfg=cfg, spec_k=3)
+    n = 10
+    h1 = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=n))
+    server.step()
+    h2 = server.submit(Request(prompt=PROMPTS[1], max_new_tokens=n))
+    server.step()  # h2 admitted while h1 is mid-burst decoding
+    h3 = server.submit(Request(prompt=PROMPTS[2], max_new_tokens=n))
+    server.run_until_drained(max_steps=100)
+    for p, h in zip(PROMPTS[:3], (h1, h2, h3)):
+        assert h.tokens == solo_greedy(params, cfg, p, n), h.request_id
+        # identical draft: the target agrees with every proposal
+        assert h.spec_proposed > 0
+        assert h.spec_accepted == h.spec_proposed
+    # every program family traced exactly once at warmup, nothing since —
+    # including the spec families (verify has traced scalars for
+    # offset/slot, so rounds at every position share one executable).
+    # NB: the prefix-copy counts are omitted — those jits wrap bare
+    # module functions, so their trace cache is shared across engine
+    # instances and other tests in the session contaminate it.
+    counts = server.compile_counts()
+    assert set(counts) == {"prefill", "decode", "prefix_load",
+                           "prefix_save", "verify", "draft_prefill",
+                           "draft_decode"}
+    assert counts["prefill"] == 1 and counts["decode"] == 1
+    assert counts["verify"] == 1
+    assert counts["draft_prefill"] == 1 and counts["draft_decode"] == 1
+    assert server.watchdog.recompiles == 0
+    assert server.metrics.spec_rounds > 0
+    assert server.metrics.spec_accept_rate == 1.0
+    assert server.metrics.spec_tokens_per_verify_mean == 4.0
+
+
+def test_spec_distinct_draft_rejections_roll_back_exactly(cfg_params):
+    """A genuinely weaker draft (the target's first layer only) gets
+    proposals rejected; rejected cache rows roll back via the stale-row
+    invariant and output is still token-exact with solo generate()."""
+    cfg, params = cfg_params
+    dparams, dcfg = truncated_draft(params, cfg)
+    server = InferenceServer(params, cfg, n_slots=4, warmup=True,
+                            draft_params=dparams, draft_cfg=dcfg, spec_k=3)
+    n = 8
+    handles = server.generate_batch(
+        [Request(prompt=p, max_new_tokens=n) for p in PROMPTS[:4]])
+    for p, h in zip(PROMPTS[:4], handles):
+        assert h.tokens == solo_greedy(params, cfg, p, n), h.request_id
+    # the 1-layer draft must actually diverge somewhere, or this test
+    # proves nothing about rollback
+    assert server.metrics.spec_proposed > 0
+    assert server.metrics.spec_accepted < server.metrics.spec_proposed
+    counts = server.compile_counts()
+    assert counts["verify"] == 1 and counts["draft_decode"] == 1
+    assert server.watchdog.recompiles == 0
+
+
+def test_spec_eos_mid_burst_truncates_and_frees_both_pools(cfg_params):
+    """EOS landing in the middle of an accepted burst: the burst tail
+    after the EOS token is dropped (never streamed), the request retires
+    as "eos", and BOTH the target and the mirrored draft slot free."""
+    cfg, params = cfg_params
+    solo = solo_greedy(params, cfg, PROMPTS[0], 12)
+    # k=3 bursts emit indices 1-4, 5-8, 9-12 after the prefill token at
+    # index 0: pick an eos whose FIRST occurrence is mid-burst (not the
+    # last index of a burst), so retirement must truncate a burst
+    idx = next(i for i in (1, 2, 3, 5, 6, 7, 9, 10, 11)
+               if solo.index(solo[i]) == i)
+    server = InferenceServer(params, cfg, n_slots=2, warmup=True,
+                             draft_params=params, draft_cfg=cfg, spec_k=3)
+    h = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=12,
+                              eos_id=solo[idx]))
+    server.run_until_drained(max_steps=100)
+    assert h.finish_reason == "eos"
+    assert h.tokens == solo[:idx + 1]  # burst tail after EOS dropped
+    assert server.engine.pool.free_count == 2
+    assert server.spec.draft.engine.pool.free_count == 2
+
+
+def test_spec_deadline_mid_burst_frees_both_pools(cfg_params):
+    """A deadline crossing BETWEEN tokens of one accepted burst: the
+    burst is the new round granularity, so expiry is enforced mid-burst —
+    the tail is dropped, finish_reason is "deadline", and both the target
+    and draft slots free in the same round."""
+    cfg, params = cfg_params
+    solo = solo_greedy(params, cfg, PROMPTS[0], 12)
+    t = {"now": 0.0}
+
+    def on_token(handle, tok):
+        # the clock jumps past the deadline after the 3rd visible token:
+        # prefill emitted index 0, so the burst of indices 1-4 is cut
+        # after index 2 by the mid-burst check (the round-top sweep at
+        # now=0.0 had already passed)
+        if len(handle.tokens) == 3:
+            t["now"] = 100.0
+
+    server = InferenceServer(params, cfg, n_slots=2, warmup=True,
+                             clock=lambda: t["now"], on_token=on_token,
+                             draft_params=params, draft_cfg=cfg, spec_k=3)
+    h = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=12,
+                              deadline_s=5.0))
+    server.run_until_drained(max_steps=100)
+    assert h.finish_reason == "deadline"
+    assert h.tokens == solo[:3]  # mid-burst cut: indices 3-4 never emitted
+    assert server.engine.pool.free_count == 2
+    assert server.spec.draft.engine.pool.free_count == 2
+    assert server.metrics.requests_expired == 1
+
+
+def test_spec_sampled_lane_falls_back_to_plain_path(cfg_params):
+    """Sampled lanes never speculate (per-token key folding must stay
+    bit-identical), and they coexist with speculating greedy lanes in the
+    same round — the plain step parks speculating lanes while the verify
+    program is their row-writer."""
+    cfg, params = cfg_params
+    sampled = Request(prompt=PROMPTS[1], max_new_tokens=8, do_sample=True,
+                      temperature=0.9, top_k=20, seed=7)
+    plain_server = InferenceServer(params, cfg, n_slots=2)
+    want = plain_server.generate_batch([dataclasses.replace(sampled)])[0]
+    server = InferenceServer(params, cfg, n_slots=2, warmup=True,
+                             draft_params=params, draft_cfg=cfg, spec_k=3)
+    h_greedy = server.submit(Request(prompt=PROMPTS[0], max_new_tokens=8))
+    h_sampled = server.submit(dataclasses.replace(sampled))
+    server.run_until_drained(max_steps=100)
+    assert h_greedy.tokens == solo_greedy(params, cfg, PROMPTS[0], 8)
+    assert h_sampled.tokens == want.tokens  # same seed, same stream
+    assert h_sampled.spec_proposed == 0  # never entered the spec path
+    assert h_greedy.spec_proposed > 0
+
+
+def test_spec_window_tail_falls_back_to_plain_decode(cfg_params):
+    """Near the end of the cache window there is no room for k+1 verify
+    rows: the lane falls back to the plain one-token step for the tail
+    (the ONLY decode trace in the run) and parity still holds end-to-end."""
+    cfg, params = cfg_params
+    prompt = list(range(1, 26))  # positions start at 25, block_size 32
+    n = 8  # exactly the clamped window: decode feeds positions 25..31
+    server = InferenceServer(params, cfg, n_slots=1,
+                             draft_params=params, draft_cfg=cfg, spec_k=2)
+    h = server.generate_batch([Request(prompt=prompt, max_new_tokens=n)])[0]
+    assert h.tokens == solo_greedy(params, cfg, prompt, n)
+    # spec rounds at pos 25 and 28 (rows fit: pos+3 <= 32), plain tail at
+    # pos 31 — so the decode family traced exactly once, ON DEMAND, and
+    # verify stayed at one executable across offsets (prefix-copy counts
+    # omitted: their jit cache is shared across engine instances)
+    counts = server.compile_counts()
+    assert counts["prefill"] == 1 and counts["decode"] == 1
+    assert counts["verify"] == 1
+    assert counts["draft_prefill"] == 1 and counts["draft_decode"] == 1
+    assert 0 < h.spec_accepted <= h.spec_proposed
+
+
+def test_spec_with_chunked_prefill_and_prefix_reuse(cfg_params):
+    """Speculation composed with chunked prefill + shared-prefix reuse:
+    the combined machinery stays token-exact and the verify family stays
+    at one executable."""
+    cfg, params = cfg_params
+    server = InferenceServer(
+        params, cfg, n_slots=2, prefill_chunk=4, prefix_cache_mb=1.0,
+        prefill_buckets=(4, 8, 16, 32), warmup=True,
+        draft_params=params, draft_cfg=cfg, spec_k=3)
+    shared = [5, 6, 7, 8, 9, 10, 11, 12]
+    prompts = [shared + [13], shared + [14], PROMPTS[0]]
+    n = 6
+    # stagger so the first twin's prefix is SAVED before the second's
+    # admission lookup (save happens at end-of-prefill)
+    h0 = server.generate_batch([Request(prompt=prompts[0],
+                                        max_new_tokens=n)])[0]
+    rest = server.generate_batch(
+        [Request(prompt=p, max_new_tokens=n) for p in prompts[1:]])
+    for p, h in zip(prompts, [h0] + rest):
+        assert h.tokens == solo_greedy(params, cfg, p, n), h.request_id
+    assert server.metrics.prefix_hits >= 1  # the second twin reused rows
+    counts = server.compile_counts()
+    assert counts["verify"] == 1 and counts["draft_decode"] == 1
+    assert counts["prefill"] <= 4 and counts["draft_prefill"] <= 4
+    assert server.watchdog.recompiles == 0
+
+
+def test_spec_slot_mirror_breakage_fails_loudly(cfg_params):
+    """The draft pool must mirror the target's slot indices 1:1; a
+    drifted mirror raises instead of silently attending the wrong lane."""
+    cfg, params = cfg_params
+    server = InferenceServer(params, cfg, n_slots=2,
+                             draft_params=params, draft_cfg=cfg, spec_k=2)
+    server.spec.draft.engine.pool.allocate()  # steal draft slot 0
+    with pytest.raises(RuntimeError, match="mirror"):
+        server.generate_batch([Request(prompt=PROMPTS[0], max_new_tokens=2)])
+
+
+def test_spec_constructor_validation(cfg_params):
+    cfg, params = cfg_params
+    with pytest.raises(ValueError):  # spec_k without a draft model
+        InferenceServer(params, cfg, spec_k=2)
+    with pytest.raises(ValueError):  # draft params without its config
+        InferenceServer(params, cfg, draft_params=params, spec_k=2)
+    with pytest.raises(ValueError):  # k = 0 is "off", not a tiny burst
+        InferenceServer(params, cfg, draft_params=params, draft_cfg=cfg,
+                        spec_k=0)
+    small = dataclasses.replace(cfg, block_size=16)
+    with pytest.raises(ValueError):  # draft window can't cover target's
+        InferenceServer(params, cfg, draft_params=params, draft_cfg=small,
+                        spec_k=2)
