@@ -1173,6 +1173,56 @@ def serving_inner() -> int:
     return 0
 
 
+def traffic_inner() -> int:
+    """``--traffic``: the traffic-lab sweep as a standalone BENCH record
+    — one JSON line whose headline is the knee rung (first offered-load
+    rung where the named SLO objective fails) and whose ``traffic``
+    block carries per-policy grades and deadline-hit-rates per rung.
+    Runs the canned selftest geometry (tiny model, VirtualClock), so it
+    works on any backend and adds nothing to existing records."""
+    import traffic as traffic_cli
+    from mingpt_distributed_tpu.trafficlab import run_sweep
+
+    cfg, params = traffic_cli._tiny_model()
+    spec = traffic_cli.selftest_sweep_spec()
+    report = run_sweep(params, cfg, spec, mix=traffic_cli.selftest_mix())
+    knee = report["knee"]
+    rungs = [
+        {
+            "rung": rung["rung"],
+            "offered_rate": rung["offered_rate"],
+            "policies": {
+                name: {
+                    "grade": cell["slo"]["grade"],
+                    "attainment": cell["slo"]["attainment"],
+                    "deadline_hit_rate": cell["deadline_hit_rate"],
+                    "completed": cell["completed"],
+                    "shed": cell["shed"],
+                    "expired": cell["expired"],
+                }
+                for name, cell in rung["policies"].items()
+            },
+        }
+        for rung in report["rungs"]
+    ]
+    print(json.dumps({
+        "metric": "traffic_knee_rung",
+        "value": None if knee is None else knee["rung"],
+        "unit": "rung",
+        "knee": knee,
+        "traffic": {
+            "schema": report["schema"],
+            "arrival": report["arrival"]["spec"],
+            "ladder": report["ladder"],
+            "policies": report["policies"],
+            "slo_spec": report["slo_spec"],
+            "knee_objective": report["knee_objective"],
+            "rungs": rungs,
+        },
+    }), flush=True)
+    return 0
+
+
 def multichip_inner() -> int:
     """Runs under the hermetic virtual-CPU env _attach_multichip sets up:
     a dp=4 mesh, one model/optimizer, and the trainer's exact update
@@ -1310,4 +1360,6 @@ if __name__ == "__main__":
         sys.exit(multichip_inner())
     if "--serving" in sys.argv:
         sys.exit(serving_inner())
+    if "--traffic" in sys.argv:
+        sys.exit(traffic_inner())
     sys.exit(main())

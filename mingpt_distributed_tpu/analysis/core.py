@@ -107,12 +107,15 @@ class Config:
     # abstraction (serving chaos harness + fault injector are only
     # deterministic because of it; request tracing and the flight
     # recorder take every timestamp from an injected clock so the
-    # chaos-gate trace assertions stay exact)
+    # chaos-gate trace assertions stay exact; the traffic lab's load
+    # sweeps are byte-replayable only because arrival schedules are
+    # virtual-timestamp data and the runner never reads a wall clock)
     clock_paths: Tuple[str, ...] = (
         "serving/",
         "training/faults.py",
         "telemetry/tracing.py",
         "telemetry/flightrec.py",
+        "trafficlab/",
     )
     # GL007: time.time() results bound to these names are telemetry
     # timestamps (epoch stamps on records), not scheduling decisions

@@ -16,7 +16,8 @@ what a server needs on top of it:
   positions, masked inactive slots, per-slot sampling params as traced
   arrays — admission never recompiles), and device-side prefix row copies.
 * ``InferenceServer`` (scheduler.py) — the continuous-batching scheduler:
-  a FIFO request queue with per-request sampling params, admission into
+  a policy-ordered request queue (``AdmissionPolicy`` in admission.py,
+  FIFO default) with per-request sampling params, admission into
   free slots at decode-step boundaries (prefix hit → chunked prefill
   interleaved with decode → first token), retirement on per-request stop
   conditions, token streaming via callbacks / request handles.
@@ -42,6 +43,7 @@ tests/test_fleet.py) and driven end-to-end by ``serve.py`` at the repo
 root.
 """
 
+from mingpt_distributed_tpu.serving.admission import AdmissionPolicy, FifoPolicy
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.fleet import (
     CircuitBreaker,
@@ -68,9 +70,11 @@ from mingpt_distributed_tpu.serving.speculative import (
 )
 
 __all__ = [
+    "AdmissionPolicy",
     "CircuitBreaker",
     "DecodeEngine",
     "DraftEngine",
+    "FifoPolicy",
     "FleetHandle",
     "InferenceServer",
     "PrefixKVStore",

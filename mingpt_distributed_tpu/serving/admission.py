@@ -1,0 +1,89 @@
+"""Pluggable admission ordering (ISSUE 12 policy hook).
+
+The scheduler's admit loop and the fleet router's retry dispatch both
+used to hard-code FIFO: ``queue.popleft()`` decided which waiting
+request got the next free KV slot. This module extracts that decision
+into :class:`AdmissionPolicy` so a policy object — the SAME object —
+can drive either a solo ``InferenceServer`` or a ``ReplicaSupervisor``
+fleet (pass it to ``InferenceServer(admission_policy=...)`` /
+``default_server_factory(..., admission_policy=...)`` and to
+``Router(admission_policy=...)``).
+
+Only the *interface* and the behavior-preserving default live here:
+:class:`FifoPolicy` selects index 0, which is exactly ``popleft()``,
+so a server constructed without a policy is unchanged. The interesting
+policies (deadline-aware EDF, fair-share per-tenant) live in
+``mingpt_distributed_tpu/trafficlab/policies.py`` with the rest of the
+traffic lab.
+
+The contract: ``sort_key(handle, position, now)`` returns a total-order
+key over *waiting* handles (smaller = admit sooner). Handles are duck-
+typed — both ``RequestHandle`` (scheduler queue) and ``FleetHandle``
+(router retry queue) expose ``.deadline`` (absolute clock seconds or
+None) and ``.request`` (with ``.tenant``), which is all the shipped
+policies read. ``on_admit`` fires when a handle actually claims a KV
+slot (the scheduler calls it; the router does NOT, so a fleet-shared
+stateful policy counts each admission exactly once).
+
+Determinism: every policy must break ties by queue position
+(``sort_key`` includes it), so admission order — and therefore the
+whole serving schedule on a virtual clock — is a pure function of the
+submitted sequence.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+__all__ = [
+    "AdmissionPolicy",
+    "FifoPolicy",
+]
+
+
+class AdmissionPolicy:
+    """Order waiting requests for admission. Subclass and implement
+    ``sort_key``; override ``on_admit`` for stateful policies."""
+
+    #: registry/report name (trafficlab reports grade policies by it)
+    name = "policy"
+
+    def sort_key(self, handle: Any, position: int,
+                 now: float) -> Tuple:  # pragma: no cover - interface
+        """Total-order key for one waiting handle (smaller admits
+        first). ``position`` is the handle's current queue index — every
+        key must include it (last) so equal-priority requests keep FIFO
+        order."""
+        raise NotImplementedError
+
+    def select(self, queue: Sequence[Any], now: float) -> int:
+        """Index of the next handle to admit from ``queue`` (non-empty)."""
+        best = 0
+        best_key = self.sort_key(queue[0], 0, now)
+        for i in range(1, len(queue)):
+            key = self.sort_key(queue[i], i, now)
+            if key < best_key:
+                best, best_key = i, key
+        return best
+
+    def order(self, handles: Sequence[Any], now: float) -> List[int]:
+        """Indices of ``handles`` in admission order (used by the fleet
+        router to drain its retry queue policy-first)."""
+        return sorted(range(len(handles)),
+                      key=lambda i: self.sort_key(handles[i], i, now))
+
+    def on_admit(self, handle: Any) -> None:
+        """A handle claimed a KV slot. Default: stateless no-op."""
+
+
+class FifoPolicy(AdmissionPolicy):
+    """Arrival order — the extracted default. ``select`` always returns
+    0, byte-identical to the old ``popleft()`` admission."""
+
+    name = "fifo"
+
+    def sort_key(self, handle: Any, position: int, now: float) -> Tuple:
+        return (position,)
+
+    def select(self, queue: Sequence[Any], now: float) -> int:
+        return 0

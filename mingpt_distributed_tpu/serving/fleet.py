@@ -61,6 +61,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from mingpt_distributed_tpu.serving.admission import AdmissionPolicy
 from mingpt_distributed_tpu.serving.requests import (
     QueueFullError,
     Request,
@@ -474,8 +475,15 @@ class Router:
         breaker_reset_s: float = 1.0,
         trace_recorder: Optional[TraceRecorder] = None,
         flight: Optional[FlightRecorder] = None,
+        admission_policy: Optional[AdmissionPolicy] = None,
     ):
         self.supervisor = supervisor
+        # admission ordering over the router's retry/pending queue
+        # (ISSUE 12). None keeps the historical FIFO drain exactly; a
+        # policy reorders only the entries whose backoff has elapsed.
+        # Pass the SAME object to default_server_factory so replica-
+        # level slot admission follows the same discipline.
+        self.admission_policy = admission_policy
         self.clock = supervisor.clock
         self.on_token = on_token
         self.affinity_len = affinity_len
@@ -885,16 +893,40 @@ class Router:
                     self._finalize(fh, "error")
 
         still: Deque[Tuple[FleetHandle, float]] = deque()
-        while self._pending:
-            fh, not_before = self._pending.popleft()
-            if fh.finished:
-                continue
-            if fh.deadline is not None and now >= fh.deadline:
-                self._finalize(fh, "deadline")
-                continue
-            if now < not_before or not self._try_route(fh):
-                still.append((fh, not_before))
-        self._pending = still
+        if self.admission_policy is None:
+            while self._pending:
+                fh, not_before = self._pending.popleft()
+                if fh.finished:
+                    continue
+                if fh.deadline is not None and now >= fh.deadline:
+                    self._finalize(fh, "deadline")
+                    continue
+                if now < not_before or not self._try_route(fh):
+                    still.append((fh, not_before))
+            self._pending = still
+        else:
+            # policy-ordered drain: entries whose backoff elapsed route
+            # in admission order; the rest keep FIFO positions. The
+            # policy's on_admit is NOT called here — slot claims happen
+            # in the replica scheduler, which counts them.
+            ready: List[Tuple[FleetHandle, float]] = []
+            while self._pending:
+                fh, not_before = self._pending.popleft()
+                if fh.finished:
+                    continue
+                if fh.deadline is not None and now >= fh.deadline:
+                    self._finalize(fh, "deadline")
+                    continue
+                if now < not_before:
+                    still.append((fh, not_before))
+                else:
+                    ready.append((fh, not_before))
+            for i in self.admission_policy.order(
+                    [fh for fh, _ in ready], now):
+                fh, not_before = ready[i]
+                if not self._try_route(fh):
+                    still.append((fh, not_before))
+            self._pending = still
 
         for rep in self.supervisor.replicas:
             if rep.state != "ready":

@@ -3,7 +3,9 @@
 The loop the server runs (``step()`` = one scheduling round):
 
 1. **Admit** — while the queue is non-empty and the pool has a free slot,
-   pop FIFO, claim the slot, and try a shared-prefix cache hit (device
+   pop the request the :class:`AdmissionPolicy` selects (FIFO by
+   default; serving/admission.py, trafficlab/policies.py for EDF /
+   fair-share), claim the slot, and try a shared-prefix cache hit (device
    row copy — the prompt's cached head costs no FLOPs, only the tail is
    prefilled).
 2. **Prefill** — every slot still prefilling advances by at most ONE
@@ -28,7 +30,8 @@ The loop the server runs (``step()`` = one scheduling round):
    round's admissions reuse it. Mid-decode admission is the whole point:
    new prompts join while others are half-way through decoding.
 
-Determinism: FIFO admission, lowest-free-slot placement, and per-request
+Determinism: policy-ordered admission (FIFO default; every shipped
+policy tie-breaks by queue position), lowest-free-slot placement, and per-request
 PRNG keys derived as ``fold_in(key(seed), token_index)`` — a sampled
 request's output depends only on (params, prompt, sampling params, seed),
 never on which other requests share the batch. Greedy requests are
@@ -83,6 +86,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.serving.admission import AdmissionPolicy, FifoPolicy
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
 from mingpt_distributed_tpu.serving.requests import (  # noqa: F401  (re-export)
@@ -205,6 +209,7 @@ class InferenceServer:
         draft_params=None,
         draft_cfg: Optional[GPTConfig] = None,
         spec_k: int = 0,
+        admission_policy: Optional[AdmissionPolicy] = None,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(
@@ -264,6 +269,12 @@ class InferenceServer:
         # that trace; one without gets a trace minted here (solo mode),
         # and then this server also owns emit events + end_trace.
         self.trace_recorder = trace_recorder
+        # admission ordering (ISSUE 12): which queued request takes the
+        # next free slot. The default FifoPolicy selects index 0 —
+        # identical to the historical popleft() — so existing behavior
+        # is preserved unless a policy is injected.
+        self.admission_policy = (admission_policy if admission_policy
+                                 is not None else FifoPolicy())
         self.queue: Deque[RequestHandle] = deque()
         self.slots = SlotTable(n_slots, cfg.block_size)
         self._ids = itertools.count()
@@ -526,7 +537,10 @@ class InferenceServer:
             self._expire_if_due(h, now)
 
         while self.queue and self.engine.pool.free_count:
-            h = self.queue.popleft()
+            idx = self.admission_policy.select(self.queue, now)
+            h = self.queue[idx]
+            del self.queue[idx]
+            self.admission_policy.on_admit(h)
             with self.tracer.span("serve.admit", request_id=h.request_id,
                                   **_trace_attrs(h)):
                 self._admit(h)

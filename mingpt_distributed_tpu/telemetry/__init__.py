@@ -69,9 +69,11 @@ from mingpt_distributed_tpu.telemetry.registry import (
 from mingpt_distributed_tpu.telemetry.slo import (
     SLO_SCHEMA,
     SLObjective,
+    diff_slo_reports,
     evaluate_slos,
     exact_quantile,
     parse_slo_spec,
+    render_slo_diff,
     render_slo_report,
 )
 from mingpt_distributed_tpu.telemetry.spans import (
@@ -116,6 +118,7 @@ __all__ = [
     "TelemetryServer",
     "TraceContext",
     "TraceRecorder",
+    "diff_slo_reports",
     "evaluate_slos",
     "exact_quantile",
     "get_registry",
@@ -130,6 +133,7 @@ __all__ = [
     "process_index",
     "register_build_info",
     "render_prometheus",
+    "render_slo_diff",
     "render_slo_report",
     "trace_baggage",
     "trace_sink",

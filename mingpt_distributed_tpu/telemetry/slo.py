@@ -186,6 +186,81 @@ def render_slo_report(report: Dict[str, Any]) -> str:
     return "\n".join(lines)
 
 
+def diff_slo_reports(a: Dict[str, Any], b: Dict[str, Any],
+                     ) -> Dict[str, Any]:
+    """Per-objective delta between two mingpt-slo/1 reports (e.g. two
+    ``serve.py --slo-json`` runs, or one run before/after a change).
+
+    Objectives are matched by name; rows present in only one report get
+    ``observed`` None on the other side and no delta. ``delta`` is
+    ``b.observed - a.observed`` (negative = b is better for these
+    lower-is-better metrics); ``verdict`` summarizes the pass/fail
+    transition (``same``, ``fixed``, ``regressed``, ``n/a``)."""
+    for label, rep in (("a", a), ("b", b)):
+        if rep.get("schema") != SLO_SCHEMA:
+            raise ValueError(
+                f"report {label} is not {SLO_SCHEMA}: "
+                f"schema={rep.get('schema')!r}")
+    rows_a = {row["name"]: row for row in a["objectives"]}
+    rows_b = {row["name"]: row for row in b["objectives"]}
+    names = list(rows_a)
+    names.extend(n for n in rows_b if n not in rows_a)
+    out_rows = []
+    for name in names:
+        ra, rb = rows_a.get(name), rows_b.get(name)
+        oa = ra.get("observed") if ra else None
+        ob = rb.get("observed") if rb else None
+        delta = (ob - oa) if (oa is not None and ob is not None) else None
+        pa = ra.get("pass") if ra else None
+        pb = rb.get("pass") if rb else None
+        if pa is None or pb is None:
+            verdict = "n/a"
+        elif pa == pb:
+            verdict = "same"
+        elif pb:
+            verdict = "fixed"
+        else:
+            verdict = "regressed"
+        out_rows.append({
+            "name": name,
+            "metric": (ra or rb)["metric"],
+            "threshold": (ra or rb)["threshold"],
+            "observed_a": oa,
+            "observed_b": ob,
+            "delta": delta,
+            "pass_a": pa,
+            "pass_b": pb,
+            "verdict": verdict,
+        })
+    return {
+        "schema": f"{SLO_SCHEMA}-diff",
+        "requests_a": a["requests"],
+        "requests_b": b["requests"],
+        "grade_a": a["grade"],
+        "grade_b": b["grade"],
+        "objectives": out_rows,
+    }
+
+
+def render_slo_diff(diff: Dict[str, Any]) -> str:
+    """Human-readable per-objective delta table for ``diff_slo_reports``."""
+    lines = [f"SLO diff ({diff['schema']}): grade {diff['grade_a']} -> "
+             f"{diff['grade_b']}  (requests {diff['requests_a']} -> "
+             f"{diff['requests_b']})"]
+    lines.append(f"  {'objective':<14} {'threshold':>10} {'a':>12} "
+                 f"{'b':>12} {'delta':>12}  verdict")
+    for row in diff["objectives"]:
+
+        def _cell(v: Optional[float]) -> str:
+            return "n/a" if v is None else f"{v:.6g}"
+
+        lines.append(
+            f"  {row['name']:<14} {row['threshold']:>10g} "
+            f"{_cell(row['observed_a']):>12} {_cell(row['observed_b']):>12} "
+            f"{_cell(row['delta']):>12}  {row['verdict']}")
+    return "\n".join(lines)
+
+
 def load_trace_requests(path: str) -> List[Dict[str, Any]]:
     """Pull the per-request summaries out of a mingpt-trace/1 JSONL
     (strictly validated) for offline SLO evaluation."""

@@ -119,11 +119,29 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python serve.py --selftest-chaos \
         --trace-jsonl "$OBS_DIR/trace.jsonl" \
         --flight-dir "$OBS_DIR/flight" \
-        --slo "ttft_p99<=60,itl_p99<=60,shed_rate<=0.5"
+        --slo "ttft_p99<=60,itl_p99<=60,shed_rate<=0.5" \
+        --slo-json "$OBS_DIR/slo.json"
 
 # The exported artifacts must round-trip through the offline tool too:
 # trace_summary renders per-request timelines + the SLO grade from the
-# same files the gate just validated in-process.
+# same files the gate just validated in-process, and --compare diffs
+# the machine-readable --slo-json report (against itself: a run
+# compared to itself must read as all-"same", exercising the diff path
+# end-to-end on real output).
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python tools/trace_summary.py "$OBS_DIR/trace.jsonl" \
         --slo "ttft_p99<=60,itl_p99<=60,shed_rate<=0.5" > /dev/null
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python tools/trace_summary.py \
+        --compare "$OBS_DIR/slo.json" "$OBS_DIR/slo.json" > /dev/null
+
+# Traffic-lab gate (ISSUE 12): a canned FIFO-vs-EDF load sweep on the
+# virtual clock — strict mingpt-traffic/1 validation after a JSON
+# round-trip, a valid knee (SLO passes at the rung below, fails at the
+# knee), EDF strictly beating FIFO on deadline hit-rate at the overload
+# rung of the IDENTICAL arrival trace, and a byte-identical report on
+# re-run (the whole lab is wall-clock-free; graftlint GL007 pins that).
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python traffic.py --selftest-traffic

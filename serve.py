@@ -73,7 +73,9 @@ flight recorder — recent spans/events/metrics dumped atomically on
 crash, breaker trip, watchdog recompile and SIGTERM drain, and
 on-demand via GET /debug/flight on the telemetry server; --slo [SPEC]
 prints a graded SLO report (TTFT/ITL percentiles + shed rate from
-exact per-request trace durations) at shutdown. With tracing on, the
+exact per-request trace durations) at shutdown; --slo-json PATH writes
+the same report as machine-readable mingpt-slo/1 JSON, diffable with
+tools/trace_summary.py --compare. With tracing on, the
 chaos gate additionally strict-validates the exported trace stream
 (one trace per request, attempt spans matching the retry count, zero
 orphan spans) and the dumped flight records.
@@ -188,6 +190,13 @@ def build_argparser() -> argparse.ArgumentParser:
                         "'metric<=threshold' clauses (ttft_pNN, itl_pNN, "
                         "shed_rate, error_rate) joined by ','; bare --slo "
                         "uses the default objectives")
+    p.add_argument("--slo-json", default=None, metavar="PATH",
+                   help="write the shutdown SLO report as machine-readable "
+                        "JSON (mingpt-slo/1, the same shape mingpt-traffic/1 "
+                        "embeds) to PATH; two runs diff with "
+                        "tools/trace_summary.py --compare a.json b.json. "
+                        "Objectives come from --slo, or the defaults when "
+                        "only --slo-json is given")
     p.add_argument("--selftest-chaos", action="store_true",
                    help="random-init tiny model through 3 replicas under "
                         "injected crash + slow faults; verifies greedy "
@@ -337,7 +346,7 @@ def _make_observability(args, reg):
             lambda: telemetry.render_prometheus(reg))
     recorder = None
     if (args.trace_jsonl is not None or args.slo is not None
-            or flight is not None):
+            or args.slo_json is not None or flight is not None):
         if not 0.0 <= args.trace_sample <= 1.0:
             raise SystemExit(
                 f"--trace-sample must be in [0, 1], got {args.trace_sample}")
@@ -349,17 +358,26 @@ def _make_observability(args, reg):
 
 
 def _slo_report(args, recorder):
-    """Evaluate --slo objectives over the recorder's completed-request
-    summaries and print the graded report; returns the report dict (or
-    None without --slo/requests)."""
+    """Evaluate SLO objectives over the recorder's completed-request
+    summaries: print the graded report with --slo, write the report dict
+    as sorted-key JSON with --slo-json (diffable via trace_summary.py
+    --compare). Returns the report dict (None when neither flag is set)."""
+    import json as _json
+
     from mingpt_distributed_tpu import telemetry
 
-    if args.slo is None or recorder is None:
+    if (args.slo is None and args.slo_json is None) or recorder is None:
         return None
-    objectives = telemetry.parse_slo_spec(args.slo)
+    objectives = telemetry.parse_slo_spec(args.slo or "default")
     report = telemetry.evaluate_slos(recorder.completed_requests(),
                                      objectives)
-    print(telemetry.render_slo_report(report))
+    if args.slo is not None:
+        print(telemetry.render_slo_report(report))
+    if args.slo_json is not None:
+        with open(args.slo_json, "w") as f:
+            f.write(_json.dumps(report, sort_keys=True, indent=2) + "\n")
+        print(f"[serve] SLO report (mingpt-slo/1) written to "
+              f"{args.slo_json}", file=sys.stderr)
     return report
 
 

@@ -1,0 +1,48 @@
+"""GL007 fixtures — wall-clock temptations in traffic-lab-shaped code.
+
+The traffic lab's whole guarantee is that a load sweep is replayable
+from ``(seed, spec)``: arrival schedules are virtual-timestamp DATA and
+the drive loop advances an injected clock. These fixtures are the
+shapes that would quietly break it.
+
+Positives: stamping an arrival with ``time.time()`` inside the
+generator; an open-loop pacer that really sleeps between arrivals.
+Suppressed: one wall-clock duration probe, inline disable.
+Negatives: a ``*Clock`` class body, a telemetry ``*_ts`` stamp, and an
+injectable clock default passed by reference.
+"""
+import time
+from time import sleep
+
+
+def emit_arrival_bad(rate):
+    return {"at": time.time() + 1.0 / rate}  # expect: GL007
+
+
+def pace_arrivals_bad(gaps):
+    for gap in gaps:
+        sleep(gap)  # expect: GL007
+
+
+def sweep_wall_seconds_suppressed():
+    return time.perf_counter()  # graftlint: disable=GL007
+
+
+def stamp_report(report):
+    report_ts = time.time()  # clean: epoch stamp on an exported record
+    report["report_ts"] = report_ts
+    return report
+
+
+def drive(clock=time.monotonic):  # clean: injectable reference, not a call
+    return clock
+
+
+class SweepClock:
+    """The virtual clock a runner should be handed instead."""
+
+    def __init__(self):
+        self._now = 0.0
+
+    def now(self):
+        return self._now or time.perf_counter()  # clean: *Clock body

@@ -286,6 +286,26 @@ def test_speculative_module_clean_under_recompile_and_clock_rules():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_trafficlab_package_clean_under_clock_rule():
+    """ISSUE 12: the traffic lab's byte-replayable sweeps depend on
+    arrival schedules being virtual-timestamp data and the runner never
+    reading a wall clock. The whole package is in GL007 scope
+    (Config.clock_paths) and must be clock-clean outright — no
+    suppressions, no baseline entries. The wall-clock shapes that would
+    break replay are pinned by the gl007_trafficlab.py fixture."""
+    pkg = os.path.join(REPO, "mingpt_distributed_tpu", "trafficlab")
+    paths = sorted(
+        os.path.join(pkg, f) for f in os.listdir(pkg) if f.endswith(".py"))
+    assert len(paths) >= 5  # __init__, arrivals, policies, report, ...
+    cfg = Engine(select=["GL007"], root=REPO).config
+    for p in paths:
+        rel = os.path.relpath(p, REPO)
+        assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL007"], root=REPO).run(paths)
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_list_rules(capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out

@@ -256,3 +256,48 @@ def test_slo_flag_rejects_non_trace_input(tmp_path, capsys):
     rc = trace_summary.main([str(p), "--slo"])
     assert rc == 1
     assert "--slo needs a mingpt-trace/1" in capsys.readouterr().err
+
+
+def _slo_report(spec, rows):
+    from mingpt_distributed_tpu.telemetry import evaluate_slos, parse_slo_spec
+
+    return evaluate_slos(rows, parse_slo_spec(spec))
+
+
+def test_compare_slo_reports(tmp_path, capsys):
+    """--compare diffs two serve.py --slo-json files: per-objective
+    observed values, deltas, and pass/fail verdicts."""
+    spec = "ttft_p50<=0.5,shed_rate<=0.5"
+    fast = [{"ttft_s": 0.05, "itl_s": [0.01], "outcome": "length"}] * 4
+    slow = [{"ttft_s": 0.90, "itl_s": [0.01], "outcome": "length"}] * 4
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(_slo_report(spec, fast)))
+    b.write_text(json.dumps(_slo_report(spec, slow)))
+
+    rc = trace_summary.main(["--compare", str(a), str(b)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SLO diff" in out and "grade A -> " in out
+    assert "regressed" in out  # ttft_p50 flipped pass -> fail
+    assert "same" in out       # shed_rate passed on both sides
+    # the reverse diff reads as a fix
+    rc = trace_summary.main(["--compare", str(b), str(a)])
+    assert rc == 0
+    assert "fixed" in capsys.readouterr().out
+
+
+def test_compare_rejects_unreadable_or_wrong_schema(tmp_path, capsys):
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(_slo_report(
+        "ttft_p50<=0.5", [{"ttft_s": 0.1, "itl_s": [], "outcome": "eos"}])))
+
+    rc = trace_summary.main(["--compare", str(good),
+                             str(tmp_path / "missing.json")])
+    assert rc == 1
+    assert "cannot read SLO report" in capsys.readouterr().err
+
+    bad = tmp_path / "bad.json"
+    bad.write_text('{"schema": "something-else/9"}')
+    rc = trace_summary.main(["--compare", str(good), str(bad)])
+    assert rc == 1
+    assert "not mingpt-slo/1" in capsys.readouterr().err

@@ -1,0 +1,406 @@
+"""Traffic lab tests (ISSUE 12) — CPU, tiny config, `not slow` tier,
+fully deterministic: seeded arrival sampling, virtual clocks, zero
+wall-clock reads (pinned separately by graftlint GL007 over the
+package).
+
+The load-bearing guarantees:
+* arrival processes replay byte-identically from ``(seed, spec)`` and
+  malformed specs are rejected at parse time;
+* workload rendering is deterministic, shared-prefix tenants draw from
+  their fixed prefix pool, and every rung of a sweep offers the same
+  request bodies (only faster);
+* admission policies order queues as documented (EDF by deadline with
+  FIFO tie-breaks, fair-share by per-tenant admission counts) and the
+  scheduler hook actually changes real admission order;
+* a sweep report strict-validates after a JSON round-trip, same-seed
+  reruns are byte-identical, graded objectives never improve as offered
+  load rises, EDF beats FIFO on deadline-hit-rate at the overload rung
+  of the identical trace, and a chaos-spec'd sweep still validates.
+"""
+
+import json
+from types import SimpleNamespace
+
+import jax
+import pytest
+
+import traffic as traffic_cli
+from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.models import gpt
+from mingpt_distributed_tpu.serving import (
+    AdmissionPolicy,
+    FifoPolicy,
+    InferenceServer,
+    Request,
+)
+from mingpt_distributed_tpu.trafficlab import (
+    DeadlinePolicy,
+    FairSharePolicy,
+    SweepSpec,
+    TenantSpec,
+    WorkloadMix,
+    arrival_times,
+    format_arrival_spec,
+    make_policy,
+    parse_arrival_spec,
+    run_sweep,
+    validate_traffic_report,
+)
+from mingpt_distributed_tpu.trafficlab.report import dump_report
+from mingpt_distributed_tpu.trafficlab.workloads import trace_digest
+
+
+@pytest.fixture(scope="module")
+def cfg_params():
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    return cfg, gpt.init(jax.random.key(0), cfg)
+
+
+@pytest.fixture(scope="module")
+def sweep_report(cfg_params):
+    """ONE 3-rung FIFO-vs-EDF sweep on the CLI's canned geometry, shared
+    by the knee/monotonicity/separation assertions below."""
+    cfg, params = cfg_params
+    spec = traffic_cli.selftest_sweep_spec(ladder=(1.0, 8.0, 24.0))
+    return run_sweep(params, cfg, spec, mix=traffic_cli.selftest_mix())
+
+
+# ---------------------------------------------------------------------
+# arrivals
+# ---------------------------------------------------------------------
+
+
+def test_arrival_trace_is_byte_identical_and_seeded():
+    spec = parse_arrival_spec("poisson:rate=50")
+    a = arrival_times(spec, 64, seed=3)
+    b = arrival_times(spec, 64, seed=3)
+    assert json.dumps(a) == json.dumps(b)
+    assert a == sorted(a) and len(a) == 64 and a[0] > 0.0
+    assert arrival_times(spec, 64, seed=4) != a
+    # distinct specs under one seed decorrelate their streams
+    assert arrival_times(parse_arrival_spec("poisson:rate=50.5"),
+                         64, seed=3) != a
+
+
+def test_arrival_mean_rate_is_roughly_offered():
+    spec = parse_arrival_spec("poisson:rate=200")
+    times = arrival_times(spec, 400, seed=0)
+    observed = len(times) / times[-1]
+    assert 0.7 * 200 < observed < 1.3 * 200
+    # scaled(4) compresses the same shape 4x
+    fast = arrival_times(spec.scaled(4.0), 400, seed=0)
+    assert fast[-1] < times[-1]
+
+
+def test_bursty_and_ramp_shapes():
+    bursty = parse_arrival_spec(
+        "bursty:rate_on=100:rate_off=1:period=2.0:duty=0.25")
+    assert bursty.rate_at(0.1) == 100.0 and bursty.rate_at(1.0) == 1.0
+    assert bursty.mean_rate() == pytest.approx(100 * 0.25 + 1 * 0.75)
+    ramp = parse_arrival_spec("ramp:rate0=10:rate1=110:duration=10")
+    assert ramp.rate_at(0.0) == 10.0
+    assert ramp.rate_at(5.0) == pytest.approx(60.0)
+    assert ramp.rate_at(99.0) == 110.0  # holds the top rate after the ramp
+
+
+def test_spec_roundtrip_is_a_fixed_point():
+    for text in ("poisson:rate=50.0",
+                 "bursty:rate_on=100.0:rate_off=1.0:period=2.0:duty=0.25",
+                 "ramp:rate0=10.0:rate1=110.0:duration=10.0"):
+        spec = parse_arrival_spec(text)
+        assert format_arrival_spec(spec) == text
+        assert parse_arrival_spec(format_arrival_spec(spec)) == spec
+
+
+@pytest.mark.parametrize("bad", [
+    "", "warp:rate=5", "poisson", "poisson:rate",
+    "poisson:rate=fast", "poisson:rate=0", "poisson:rate=5:rate=6",
+    "poisson:burst=5", "bursty:rate_on=1:rate_off=1:period=0:duty=0.5",
+    "bursty:rate_on=1:rate_off=1:period=1:duty=1.5",
+    "ramp:rate0=1:rate1=2:duration=0",
+])
+def test_malformed_arrival_specs_rejected(bad):
+    with pytest.raises(ValueError):
+        parse_arrival_spec(bad)
+
+
+# ---------------------------------------------------------------------
+# workloads
+# ---------------------------------------------------------------------
+
+
+def test_render_is_deterministic_and_digested():
+    mix = traffic_cli.selftest_mix()
+    times = arrival_times(parse_arrival_spec("poisson:rate=80"), 40, seed=1)
+    a = mix.render(times, seed=1)
+    b = mix.render(times, seed=1)
+    assert [t.to_json() for t in a] == [t.to_json() for t in b]
+    assert trace_digest(a) == trace_digest(b)
+    assert trace_digest(mix.render(times, seed=2)) != trace_digest(a)
+    assert [t.t for t in a] == times
+    assert {t.tenant for t in a} <= {"chat", "batch", "assist"}
+
+
+def test_shared_prefix_tenants_draw_from_their_pool():
+    mix = WorkloadMix(vocab_size=96, tenants=(
+        TenantSpec(name="assist", family="prefix", prompt_len=(8, 12),
+                   max_new=(2, 4), prefix_pool=2, prefix_len=5),
+    ))
+    times = arrival_times(parse_arrival_spec("poisson:rate=50"), 30, seed=0)
+    timed = mix.render(times, seed=0)
+    heads = {t.prompt[:5] for t in timed}
+    assert len(heads) == 2  # every prompt opens with one of the 2 prefixes
+    assert all(len(t.prompt) >= 6 for t in timed)  # unique suffix appended
+
+
+def test_timed_request_mints_fresh_requests():
+    mix = traffic_cli.selftest_mix()
+    times = arrival_times(parse_arrival_spec("poisson:rate=50"), 4, seed=0)
+    tr = mix.render(times, seed=0)[0]
+    r1, r2 = tr.to_request(), tr.to_request()
+    assert r1 is not r2 and r1.prompt == r2.prompt
+    r1.trace = object()  # a router stamping one run must not leak...
+    assert tr.to_request().trace is None  # ...into the next policy's run
+
+
+def test_workload_validation_rejects_bad_mixes():
+    with pytest.raises(ValueError):
+        WorkloadMix(vocab_size=96, tenants=()).validate()
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", family="warp").validate()
+    with pytest.raises(ValueError):
+        TenantSpec(name="x", prompt_len=(4, 2)).validate()
+    with pytest.raises(ValueError):  # prefix at least as long as prompts
+        TenantSpec(name="x", prompt_len=(4, 8), prefix_pool=2,
+                   prefix_len=4).validate()
+    with pytest.raises(ValueError):  # duplicate tenant names
+        WorkloadMix(vocab_size=96, tenants=(
+            TenantSpec(name="a"), TenantSpec(name="a"))).validate()
+
+
+# ---------------------------------------------------------------------
+# policies
+# ---------------------------------------------------------------------
+
+
+def _handle(deadline=None, tenant=None):
+    return SimpleNamespace(deadline=deadline,
+                           request=SimpleNamespace(tenant=tenant))
+
+
+def test_fifo_policy_is_popleft():
+    p = FifoPolicy()
+    queue = [_handle(deadline=1.0), _handle(), _handle(deadline=0.1)]
+    assert p.select(queue, now=0.0) == 0
+    assert p.order(queue, now=0.0) == [0, 1, 2]
+
+
+def test_edf_orders_by_deadline_with_fifo_tiebreak():
+    p = DeadlinePolicy()
+    queue = [_handle(), _handle(deadline=9.0), _handle(deadline=2.0),
+             _handle(deadline=2.0), _handle()]
+    assert p.select(queue, now=0.0) == 2
+    # deadlines first (earliest wins, ties by position), deadline-free
+    # handles keep arrival order at the back
+    assert p.order(queue, now=0.0) == [2, 3, 1, 0, 4]
+
+
+def test_fair_share_counts_admissions_per_tenant():
+    p = FairSharePolicy()
+    a1, a2, b1 = (_handle(tenant="a"), _handle(tenant="a"),
+                  _handle(tenant="b"))
+    assert p.select([a1, a2, b1], now=0.0) == 0  # all zero: FIFO
+    p.on_admit(a1)
+    assert p.select([a2, b1], now=0.0) == 1  # b has fewer admissions
+    p.on_admit(b1)
+    assert p.select([a2], now=0.0) == 0
+    assert p.admitted == {"a": 1, "b": 1}
+    assert p._tenant(_handle()) == "_"  # tenant-less bucket
+
+
+def test_make_policy_registry():
+    assert isinstance(make_policy("fifo"), FifoPolicy)
+    assert isinstance(make_policy("edf"), AdmissionPolicy)
+    # stateful policies come out fresh per call, never shared
+    assert make_policy("fair") is not make_policy("fair")
+    with pytest.raises(ValueError):
+        make_policy("lifo")
+
+
+def test_scheduler_admission_follows_the_policy(cfg_params):
+    """The hook changes REAL admission: three requests queued before the
+    first step on a one-slot server complete in policy order — EDF by
+    deadline (deadline-free last), FIFO by arrival. Same geometry, same
+    requests, same (frozen) clock."""
+    cfg, params = cfg_params
+
+    def completion_order(policy):
+        server = InferenceServer(params, cfg, n_slots=1,
+                                 clock=lambda: 0.0,
+                                 admission_policy=policy)
+        handles = [
+            ("first", server.submit(Request(prompt=[1, 2],
+                                            max_new_tokens=2))),
+            ("relaxed", server.submit(Request(prompt=[3, 4],
+                                              max_new_tokens=2,
+                                              deadline_s=90.0))),
+            ("urgent", server.submit(Request(prompt=[5, 6],
+                                             max_new_tokens=2,
+                                             deadline_s=5.0))),
+        ]
+        order = []
+        for _ in range(200):
+            alive = server.step()
+            for name, h in handles:
+                if h.finished and name not in order:
+                    order.append(name)
+            if not alive:
+                break
+        assert all(h.finished for _, h in handles)
+        return order
+
+    assert completion_order(make_policy("edf")) == \
+        ["urgent", "relaxed", "first"]
+    assert completion_order(make_policy("fifo")) == \
+        ["first", "relaxed", "urgent"]
+
+
+# ---------------------------------------------------------------------
+# the sweep
+# ---------------------------------------------------------------------
+
+
+def test_sweep_report_validates_and_is_byte_identical(cfg_params):
+    """Same (seed, spec, mix) -> byte-identical mingpt-traffic/1 report,
+    and the report strict-validates after a JSON round-trip."""
+    cfg, params = cfg_params
+    spec = SweepSpec(arrival="poisson:rate=40.0", ladder=(1.0, 4.0),
+                     policies=("fifo",), n_requests=12, seed=7,
+                     n_replicas=1, n_slots=2,
+                     slo="ttft_p95<=0.025,shed_rate<=0.5")
+    mix = traffic_cli.selftest_mix()
+    a = run_sweep(params, cfg, spec, mix=mix)
+    b = run_sweep(params, cfg, spec, mix=mix)
+    assert dump_report(a) == dump_report(b)
+    assert validate_traffic_report(json.loads(dump_report(a)),
+                                   strict=False) == []
+    # a different seed is a different trace, hence a different report
+    c = run_sweep(params, cfg,
+                  SweepSpec(**{**spec.__dict__, "seed": 8}), mix=mix)
+    assert dump_report(c) != dump_report(a)
+    assert (c["rungs"][0]["trace_sha256"]
+            != a["rungs"][0]["trace_sha256"])
+
+
+def test_rungs_share_the_identical_arrival_trace(sweep_report):
+    """Within a rung every policy cell was graded on the same rendered
+    trace (one digest per rung), and rungs offer the same bodies faster
+    (digests differ only because timestamps compress)."""
+    digests = [r["trace_sha256"] for r in sweep_report["rungs"]]
+    assert len(set(digests)) == len(digests)
+    for rung in sweep_report["rungs"]:
+        assert set(rung["policies"]) == {"fifo", "edf"}
+        for cell in rung["policies"].values():
+            accounted = (cell["completed"] + cell["shed"]
+                         + cell["expired"] + cell["errors"])
+            assert accounted == rung["n_requests"]
+
+
+def test_grades_never_improve_as_load_rises(sweep_report):
+    """Knee monotonicity on the canned geometry: per policy, SLO
+    attainment is non-increasing up the ladder and no objective flips
+    fail -> pass at a higher rung."""
+    for policy in sweep_report["policies"]:
+        attainments = []
+        failed = set()
+        for rung in sweep_report["rungs"]:
+            slo = rung["policies"][policy]["slo"]
+            attainments.append(slo["attainment"])
+            for row in slo["objectives"]:
+                if row["pass"] is False:
+                    failed.add(row["name"])
+                elif row["pass"] is True:
+                    assert row["name"] not in failed, (
+                        f"{policy}/{row['name']} recovered at higher load")
+        assert attainments == sorted(attainments, reverse=True)
+
+
+def test_knee_located_with_pass_fail_shape(sweep_report):
+    knee = sweep_report["knee"]
+    assert knee is not None and knee["valid"]
+    assert knee["objective"] == "ttft_p95"
+    rung = knee["rung"]
+    assert rung >= 1
+    prev = sweep_report["rungs"][rung - 1]["policies"][knee["policy"]]
+    curr = sweep_report["rungs"][rung]["policies"][knee["policy"]]
+
+    def row(cell):
+        return next(r for r in cell["slo"]["objectives"]
+                    if r["name"] == knee["objective"])
+
+    assert row(prev)["pass"] is True and row(curr)["pass"] is False
+
+
+def test_edf_beats_fifo_on_deadline_hit_rate_under_overload(sweep_report):
+    last = sweep_report["rungs"][-1]["policies"]
+    edf, fifo = last["edf"], last["fifo"]
+    assert edf["deadline_requests"] == fifo["deadline_requests"] > 0
+    assert edf["deadline_hit_rate"] > fifo["deadline_hit_rate"]
+
+
+def test_chaos_spec_composes_and_still_validates(cfg_params):
+    """The same sweep under an injected replica crash: requests retry on
+    the survivor, the report still strict-validates, outcomes still
+    account for every offered request."""
+    cfg, params = cfg_params
+    spec = SweepSpec(arrival="poisson:rate=40.0", ladder=(1.0,),
+                     policies=("fifo",), n_requests=12, seed=0,
+                     n_replicas=2, n_slots=2,
+                     slo="ttft_p95<=0.5,error_rate<=0.5",
+                     chaos_spec="crash:nth=4:match=replica0")
+    report = run_sweep(params, cfg, spec,
+                       mix=traffic_cli.selftest_mix())
+    assert validate_traffic_report(json.loads(dump_report(report)),
+                                   strict=False) == []
+    assert report["chaos_spec"] == "crash:nth=4:match=replica0"
+    cell = report["rungs"][0]["policies"]["fifo"]
+    accounted = (cell["completed"] + cell["shed"] + cell["expired"]
+                 + cell["errors"])
+    assert accounted == 12 and cell["completed"] > 0
+
+
+def test_validator_rejects_tampered_reports(sweep_report):
+    good = json.loads(dump_report(sweep_report))
+    assert validate_traffic_report(good, strict=False) == []
+
+    broken = json.loads(dump_report(sweep_report))
+    del broken["rungs"][1]
+    assert validate_traffic_report(broken, strict=False)
+
+    broken = json.loads(dump_report(sweep_report))
+    broken["ladder"] = list(reversed(broken["ladder"]))
+    assert validate_traffic_report(broken, strict=False)
+
+    broken = json.loads(dump_report(sweep_report))
+    broken["rungs"][0]["policies"]["fifo"]["completed"] += 1
+    assert validate_traffic_report(broken, strict=False)
+
+    broken = json.loads(dump_report(sweep_report))
+    broken["schema"] = "mingpt-traffic/0"
+    with pytest.raises(ValueError):
+        validate_traffic_report(broken, strict=True)
+
+
+def test_sweep_spec_validation():
+    with pytest.raises(ValueError):
+        SweepSpec(ladder=(2.0, 1.0)).validate()
+    with pytest.raises(ValueError):
+        SweepSpec(policies=("fifo", "fifo")).validate()
+    with pytest.raises(ValueError):
+        SweepSpec(arrival="warp:rate=1").validate()
+    with pytest.raises(ValueError):
+        SweepSpec(slo="vibes<=0.5").validate()
+    SweepSpec().validate()
