@@ -115,6 +115,7 @@ class Config:
         "training/faults.py",
         "telemetry/tracing.py",
         "telemetry/flightrec.py",
+        "telemetry/attribution.py",
         "trafficlab/",
     )
     # GL007: time.time() results bound to these names are telemetry

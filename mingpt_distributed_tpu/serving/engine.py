@@ -427,3 +427,49 @@ class DecodeEngine:
             "prefix_load": self._install_jit._cache_size(),
             "prefix_save": self._extract_jit._cache_size(),
         }
+
+    # -- performance attribution (ISSUE 13) ----------------------------
+    def register_attrib(self, ledger, clock, family_prefix: str = "") -> None:
+        """Register every compiled program family of this engine with a
+        telemetry/attribution.py ProgramLedger: AOT-lower + compile each
+        family against its real call signature, recording compile time
+        (via the injected clock) and cost_analysis FLOPs/bytes. The AOT
+        path never touches the jit call caches, so ``compile_counts()``
+        and the recompile watchdog are unaffected; it does warm the
+        backend compilation cache, so a later ``warmup()`` retrace is
+        cheap. Family names mirror ``compile_counts()`` keys (prefixed
+        for a draft engine); prefill/prefix variants are per ladder
+        bucket."""
+        key = jax.random.key(0)
+        for b in self.buckets:
+            ledger.register_aot(
+                family_prefix + "prefill", self._prefill_jit,
+                (self.params, self.pool.cache, jnp.zeros(b, jnp.int32),
+                 np.int32(b), np.int32(0), np.int32(0),
+                 np.float32(1.0), np.int32(0), np.float32(1.0),
+                 np.bool_(False), key),
+                clock, variant=f"b{b}")
+        s = self.n_slots
+        ledger.register_aot(
+            family_prefix + "decode", self._decode_jit,
+            (self.params, self.pool.cache,
+             jnp.zeros(s, jnp.int32), jnp.zeros(s, jnp.int32),
+             jnp.ones(s, jnp.float32), jnp.zeros(s, jnp.int32),
+             jnp.ones(s, jnp.float32), jnp.zeros(s, bool),
+             jnp.stack([key] * s)),
+            clock)
+        if self.prefix_store is not None:
+            l, _, _, kv, hd = self.pool.cache["k"].shape
+            dt = self.pool.cache["k"].dtype
+            for b in self.buckets:
+                if b > self.prefill_len - 1:
+                    continue
+                ledger.register_aot(
+                    family_prefix + "prefix_save", self._extract_jit,
+                    (self.pool.cache, np.int32(0)),
+                    clock, variant=f"b{b}", kwargs={"rows": b})
+                entry = jax.ShapeDtypeStruct((l, 1, b, kv, hd), dt)
+                ledger.register_aot(
+                    family_prefix + "prefix_load", self._install_jit,
+                    (self.pool.cache, entry, entry, np.int32(0)),
+                    clock, variant=f"b{b}")

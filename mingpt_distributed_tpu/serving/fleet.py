@@ -69,7 +69,11 @@ from mingpt_distributed_tpu.serving.requests import (
     ShedError,
 )
 from mingpt_distributed_tpu.serving.scheduler import InferenceServer
-from mingpt_distributed_tpu.telemetry import MetricsRegistry, render_prometheus
+from mingpt_distributed_tpu.telemetry import (
+    MetricsRegistry,
+    render_fleet_prometheus,
+    render_prometheus,
+)
 from mingpt_distributed_tpu.telemetry.flightrec import FlightRecorder
 from mingpt_distributed_tpu.telemetry.tracing import (
     TraceContext,
@@ -1005,6 +1009,37 @@ class Router:
             "pending": len(self._pending),
             "in_flight": len(self._attempts),
         }
+
+    # -- fleet-wide observability (ISSUE 13) -------------------------------
+    def fleet_metrics_page(self) -> str:
+        """One merged Prometheus page for the whole fleet: the shared
+        (supervisor/router) registry as-is, plus every live replica's
+        PRIVATE registry re-labelled under ``replica=<name>``. Built from
+        the Replica wrappers — not captured server objects — so a respawn
+        is picked up automatically, exactly like the flight recorder's
+        lazy metrics providers."""
+        return render_fleet_prometheus(
+            self.supervisor.registry,
+            {rep.name: rep.server.metrics.registry
+             for rep in self.supervisor.replicas},
+        )
+
+    def attrib_report(self, include_live: bool = False) -> Dict[str, Any]:
+        """Fleet attribution: one ``mingpt-attrib/1`` document per
+        replica whose server was built with ``attrib=True``, keyed by
+        replica name. Replicas without a ledger are skipped (a fleet may
+        mix instrumented and plain servers); raises only when NO replica
+        has attribution enabled."""
+        replicas = {
+            rep.name: rep.server.attrib_report(include_live=include_live)
+            for rep in self.supervisor.replicas
+            if rep.server.attrib is not None
+        }
+        if not replicas:
+            raise ValueError(
+                "no replica has attribution enabled — pass attrib=True "
+                "to the server factory")
+        return {"schema": "mingpt-attrib-fleet/1", "replicas": replicas}
 
     def summary(self) -> Dict[str, Any]:
         return {

@@ -97,10 +97,14 @@ from mingpt_distributed_tpu.serving.requests import (  # noqa: F401  (re-export)
 )
 from mingpt_distributed_tpu.serving.speculative import SpeculativeDecoder
 from mingpt_distributed_tpu.telemetry import (
+    HBMLedger,
     MetricsRegistry,
+    ProgramLedger,
     RecompileWatchdog,
     SpanTracer,
+    build_attrib_report,
     log_event,
+    tree_bytes,
 )
 from mingpt_distributed_tpu.telemetry.tracing import (
     TraceRecorder,
@@ -210,6 +214,7 @@ class InferenceServer:
         draft_cfg: Optional[GPTConfig] = None,
         spec_k: int = 0,
         admission_policy: Optional[AdmissionPolicy] = None,
+        attrib: bool = False,
     ):
         self.cfg = cfg
         self.engine = DecodeEngine(
@@ -275,6 +280,23 @@ class InferenceServer:
         # is preserved unless a policy is injected.
         self.admission_policy = (admission_policy if admission_policy
                                  is not None else FifoPolicy())
+        # performance attribution (ISSUE 13): a per-server program + HBM
+        # ledger registered into this server's metrics registry, so a
+        # respawned replica starts a fresh ledger and the fleet-merged
+        # scrape sees it under the replica's label. Registration is AOT
+        # (jit-cache-neutral — the armed watchdog never sees it) and all
+        # timing flows through self.clock, so attribution on a
+        # VirtualClock is byte-deterministic.
+        self.attrib: Optional[ProgramLedger] = None
+        self.hbm: Optional[HBMLedger] = None
+        if attrib:
+            areg = self.metrics.registry if registry is None else registry
+            self.attrib = ProgramLedger(registry=areg)
+            self.hbm = HBMLedger(registry=areg)
+            self.engine.register_attrib(self.attrib, self.clock)
+            if self.spec is not None:
+                self.spec.register_attrib(self.attrib, self.clock)
+            self._account_hbm()
         self.queue: Deque[RequestHandle] = deque()
         self.slots = SlotTable(n_slots, cfg.block_size)
         self._ids = itertools.count()
@@ -283,6 +305,35 @@ class InferenceServer:
             if self.spec is not None:
                 self.spec.warmup()
             self.watchdog.arm()
+
+    # -- performance attribution (ISSUE 13) ----------------------------
+    def _account_hbm(self) -> None:
+        """Declare bytes-by-owner from shapes/dtypes: params, the KV
+        slot pool, the prefix store's current residency, and (with
+        speculation on) the draft model's params and mirrored pool.
+        Re-run before each report so LRU churn in the prefix store is
+        reflected."""
+        if self.hbm is None:
+            return
+        self.hbm.account("params", tree_bytes(self.engine.params))
+        self.hbm.account("kv_pool", tree_bytes(self.engine.pool.cache))
+        store = self.engine.prefix_store
+        self.hbm.account("prefix_store",
+                         0 if store is None else store.used_bytes)
+        if self.spec is not None:
+            de = self.spec.draft.engine
+            self.hbm.account("draft_params", tree_bytes(de.params))
+            self.hbm.account("draft_pool", tree_bytes(de.pool.cache))
+
+    def attrib_report(self, include_live: bool = False) -> Dict[str, Any]:
+        """The mingpt-attrib/1 report for this server (raises when the
+        server was built without ``attrib=True``)."""
+        if self.attrib is None:
+            raise ValueError(
+                "attribution not enabled — construct with attrib=True")
+        self._account_hbm()
+        return build_attrib_report(self.attrib, self.hbm,
+                                   include_live=include_live)
 
     # -- submission ----------------------------------------------------
     def submit(self, request: Request) -> RequestHandle:
@@ -455,6 +506,9 @@ class InferenceServer:
         self.slots.bind(slot, handle, handle.request.seed)
         t0 = self.clock()
         hit = self.engine.try_load_prefix(slot, handle.prompt_used)
+        if self.attrib is not None and hit > 0:
+            self.attrib.observe_call("prefix_load", self.clock() - t0,
+                                     variant=f"b{hit}")
         if rec is not None and handle.trace is not None:
             rec.add_span(
                 handle.trace, "serve.prefix_lookup", ts=t0,
@@ -493,6 +547,8 @@ class InferenceServer:
         )
         t1 = self.clock()
         self.metrics.on_prefill_chunk(end - pos, padded, t1 - t0)
+        if self.attrib is not None:
+            self.attrib.observe_call("prefill", t1 - t0, variant=f"b{padded}")
         if self.trace_recorder is not None and handle.trace is not None:
             self.trace_recorder.add_span(
                 handle.trace, "serve.prefill_chunk", ts=t0, dur_s=t1 - t0,
@@ -503,12 +559,21 @@ class InferenceServer:
             return
         handle.prefilling = False
         if self.engine.prefix_store is not None:
-            self.engine.save_prefix(slot, prompt)
+            ts0 = self.clock()
+            rows = self.engine.save_prefix(slot, prompt)
+            if self.attrib is not None and rows > 0:
+                self.attrib.observe_call("prefix_save", self.clock() - ts0,
+                                         variant=f"b{rows}")
         if self.spec is not None:
             # one-shot draft prefill of the full prompt: draft state only
             # shapes proposal quality, so it skips chunking/prefix reuse
+            tp0 = self.clock()
             self.spec.prime(
                 slot, prompt, jax.random.fold_in(self.slots.req_keys[slot], 0))
+            if self.attrib is not None:
+                b = self.spec.draft.engine.bucket_for(len(prompt))
+                self.attrib.observe_call("draft_prefill",
+                                         self.clock() - tp0, variant=f"b{b}")
         ok = self._emit(handle, tok)
         now = self.clock()
         self.metrics.on_prefill(
@@ -573,6 +638,7 @@ class InferenceServer:
                 plain = [s for s in active if s not in spec_slots]
                 burst: Dict[int, List[int]] = {}
                 if plain:
+                    tdp = self.clock()
                     if spec_slots:
                         # park speculating lanes: the verify program is
                         # their row-writer this round
@@ -588,23 +654,36 @@ class InferenceServer:
                             st.tokens, st.positions, st.temps, st.top_ks,
                             st.top_ps, st.do_sample, st.stacked_keys(),
                         )
+                    if self.attrib is not None:
+                        self.attrib.observe_call("decode",
+                                                 self.clock() - tdp)
                     for s in plain:
                         burst[s] = [int(nxt[s])]
                 if spec_slots:
                     smask = np.zeros(st.n_slots, bool)
                     smask[spec_slots] = True
+                    tdr = self.clock()
                     proposals = self.spec.propose(
                         st.tokens, st.positions, smask, st.stacked_keys())
+                    if self.attrib is not None:
+                        self.attrib.observe_call(
+                            "draft_decode", self.clock() - tdr,
+                            n=self.spec.k)
                     fill_mask = np.zeros(st.n_slots, bool)
                     fill_toks = np.zeros(st.n_slots, np.int32)
                     fill_pos = np.zeros(st.n_slots, np.int32)
                     for s in spec_slots:
                         rows = [int(st.tokens[s])] + \
                             [int(t) for t in proposals[s]]
+                        tv0 = self.clock()
                         g = self.spec.verify(
                             s, rows, int(st.positions[s]),
                             float(st.temps[s]), int(st.top_ks[s]),
                             float(st.top_ps[s]), st.keys[s])
+                        if self.attrib is not None:
+                            self.attrib.observe_call(
+                                "verify", self.clock() - tv0,
+                                variant=f"k{self.spec.k}")
                         n_acc = self.spec.accept_len(proposals[s], g)
                         burst[s] = [int(t) for t in g[:n_acc]]
                         if n_acc == self.spec.k + 1:

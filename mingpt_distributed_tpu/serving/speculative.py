@@ -294,3 +294,19 @@ class SpeculativeDecoder:
             "draft_prefill": draft["prefill"],
             "draft_decode": draft["decode"],
         }
+
+    def register_attrib(self, ledger, clock) -> None:
+        """Attribution registration (ISSUE 13): the verify program plus
+        the draft engine's families under the ``draft_`` prefix —
+        matching the ``compile_counts()`` family names, AOT and
+        jit-cache-neutral exactly like ``DecodeEngine.register_attrib``."""
+        key = jax.random.key(0)
+        ledger.register_aot(
+            "verify", self._verify_jit,
+            (self.target.params, self.target.pool.cache,
+             jnp.zeros(self.rows, jnp.int32),
+             np.int32(0), np.int32(0),
+             np.float32(1.0), np.int32(0), np.float32(1.0), key),
+            clock, variant=f"k{self.k}")
+        self.draft.engine.register_attrib(ledger, clock,
+                                          family_prefix="draft_")

@@ -1,0 +1,518 @@
+"""Performance attribution (ISSUE 13 tentpole): where the flops, bytes
+and compile seconds actually go.
+
+Two ledgers, one report (``mingpt-attrib/1``):
+
+* :class:`ProgramLedger` — every lifetime-compiled executable family
+  (prefill buckets, decode step, spec verify/draft, train step, zero
+  update) registers at compile time with its compile wall-time and the
+  XLA ``cost_analysis()`` FLOPs / bytes-accessed, then accumulates
+  invocation counts and sampled device wall-time from the scheduling
+  loop's existing clock measurements. Per family the report derives a
+  roofline position: arithmetic intensity, the roofline-*expected* MFU
+  ceiling (``min(1, intensity / machine_balance)``) and the *measured*
+  MFU, both against ``telemetry/peaks.py`` — so a family reading 0.04
+  measured vs 0.9 expected is leaving compute on the table, while 0.04
+  vs 0.05 is simply bandwidth-bound decode behaving as the roofline
+  says it must.
+* :class:`HBMLedger` — exact bytes-by-owner computed from shapes and
+  dtypes (params, optimizer state zero_dp-aware via
+  ``parallel/zero.py:opt_moment_bytes``, KV slot pool, prefix store,
+  draft pool), a ``jax.live_arrays()`` leak audit (live but unowned
+  bytes), and a headroom gauge against the chip's HBM capacity.
+
+Clock discipline: this module NEVER reads a clock. Compile timing goes
+through :func:`timed_aot_compile`'s injected ``clock`` callable and
+invocation timing arrives as already-measured durations from callers
+that own a clock seam (the scheduler's ``self.clock``), so GL007 holds
+outright and attribution reports on ``VirtualClock`` are
+byte-deterministic (``dump_attrib_report`` sorts keys; the
+``jax.live_arrays()`` audit is excluded from the report by default
+because leftover buffers from a previous run are process state, not
+report state).
+
+AOT registration is watchdog-safe: ``jit_fn.lower(args).compile()``
+does not populate the jit call cache (``_cache_size()`` is unchanged),
+so registering a family next to an armed :class:`RecompileWatchdog`
+never trips it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from mingpt_distributed_tpu.telemetry.peaks import (
+    peak_flops_per_chip,
+    peak_hbm_bytes_per_chip,
+    peak_hbm_capacity_per_chip,
+)
+from mingpt_distributed_tpu.telemetry.registry import MetricsRegistry
+
+__all__ = [
+    "ATTRIB_SCHEMA",
+    "HBMLedger",
+    "ProgramLedger",
+    "build_attrib_report",
+    "dump_attrib_report",
+    "kv_cache_bytes",
+    "render_attrib_report",
+    "timed_aot_compile",
+    "tree_bytes",
+    "validate_attrib_report",
+]
+
+ATTRIB_SCHEMA = "mingpt-attrib/1"
+
+
+# ---------------------------------------------------------------------
+# cost_analysis plumbing
+# ---------------------------------------------------------------------
+
+
+def _cost_to_flops_bytes(
+    cost: Any,
+) -> Tuple[Optional[float], Optional[float]]:
+    """Normalise ``Compiled.cost_analysis()`` output. Backends disagree
+    on the container (CPU returns a list with one dict per program,
+    some return the dict bare, some return None); the keys are stable:
+    ``"flops"`` and ``"bytes accessed"``."""
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else None
+    if not isinstance(cost, dict):
+        return None, None
+    flops = cost.get("flops")
+    byts = cost.get("bytes accessed")
+    return (
+        float(flops) if flops is not None else None,
+        float(byts) if byts is not None else None,
+    )
+
+
+def timed_aot_compile(
+    jit_fn: Any,
+    args: Tuple[Any, ...],
+    clock: Callable[[], float],
+    kwargs: Optional[Dict[str, Any]] = None,
+) -> Tuple[float, Optional[float], Optional[float]]:
+    """AOT-lower and compile a jitted callable against ``args``,
+    returning ``(compile_seconds, flops, bytes_accessed)``.
+
+    Timing is read from the injected ``clock`` only (on a VirtualClock
+    the duration is exactly 0.0 — deterministic, which the byte-identity
+    selftest relies on). The AOT path shares the backend compilation
+    cache with the normal call path but does NOT insert into the jit
+    call cache, so ``_cache_size()``-based recompile accounting (the
+    watchdog, ``compile_counts`` selftests) is unaffected.
+    """
+    t0 = clock()
+    compiled = jit_fn.lower(*args, **(kwargs or {})).compile()
+    t1 = clock()
+    try:
+        cost = compiled.cost_analysis()
+    except Exception:  # backends without cost models still attribute time
+        cost = None
+    flops, byts = _cost_to_flops_bytes(cost)
+    return t1 - t0, flops, byts
+
+
+# ---------------------------------------------------------------------
+# Program ledger
+# ---------------------------------------------------------------------
+
+
+class _ProgramStats:
+    __slots__ = ("compiles", "compile_s", "flops", "bytes_accessed",
+                 "calls", "device_s")
+
+    def __init__(self) -> None:
+        self.compiles = 0
+        self.compile_s = 0.0
+        self.flops: Optional[float] = None
+        self.bytes_accessed: Optional[float] = None
+        self.calls = 0
+        self.device_s = 0.0
+
+
+class ProgramLedger:
+    """Per-program-family cost ledger.
+
+    Families register once at compile time (``observe_compile`` or the
+    ``register_aot`` convenience that wraps :func:`timed_aot_compile`)
+    and accumulate invocation samples (``observe_call``) from whatever
+    loop owns the clock. ``variant`` distinguishes members of a family
+    that compile separately (prefill bucket sizes, zero vs dense train
+    step) while keeping one logical row group.
+    """
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._programs: Dict[Tuple[str, str], _ProgramStats] = {}
+        r = self.registry
+        labels = ("family", "variant")
+        self._g_flops = r.gauge(
+            "mingpt_attrib_flops",
+            help="cost_analysis FLOPs of one invocation of this program",
+            labels=labels)
+        self._g_bytes = r.gauge(
+            "mingpt_attrib_bytes_accessed",
+            help="cost_analysis bytes accessed by one invocation",
+            labels=labels)
+        self._g_compile = r.gauge(
+            "mingpt_attrib_compile_seconds",
+            help="cumulative compile wall-time of this program family",
+            labels=labels)
+        self._c_calls = r.counter(
+            "mingpt_attrib_calls_total",
+            help="invocations observed for this program family",
+            labels=labels)
+        self._c_device = r.counter(
+            "mingpt_attrib_device_seconds_total",
+            help="sampled device wall-time spent in this program family",
+            labels=labels)
+        self._g_mfu = r.gauge(
+            "mingpt_attrib_mfu",
+            help="measured model FLOPs utilisation vs the chip peak "
+                 "(absent off-TPU: no peak table row)",
+            labels=labels)
+
+    # -- registration --------------------------------------------------
+    def observe_compile(
+        self,
+        family: str,
+        compile_s: float,
+        flops: Optional[float],
+        bytes_accessed: Optional[float],
+        variant: str = "",
+    ) -> None:
+        st = self._programs.setdefault((family, variant), _ProgramStats())
+        st.compiles += 1
+        st.compile_s += float(compile_s)
+        # cost_analysis is a property of the program, not the call: keep
+        # the latest non-None reading (re-registration is idempotent)
+        if flops is not None:
+            st.flops = float(flops)
+        if bytes_accessed is not None:
+            st.bytes_accessed = float(bytes_accessed)
+        lab = dict(family=family, variant=variant)
+        self._g_compile.labels(**lab).set(st.compile_s)
+        if st.flops is not None:
+            self._g_flops.labels(**lab).set(st.flops)
+        if st.bytes_accessed is not None:
+            self._g_bytes.labels(**lab).set(st.bytes_accessed)
+        # pre-touch the call counters so a registered-but-never-invoked
+        # family is still visible on the scrape page at 0
+        self._c_calls.labels(**lab)
+        self._c_device.labels(**lab)
+
+    def register_aot(
+        self,
+        family: str,
+        jit_fn: Any,
+        args: Tuple[Any, ...],
+        clock: Callable[[], float],
+        variant: str = "",
+        kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        compile_s, flops, byts = timed_aot_compile(
+            jit_fn, args, clock, kwargs=kwargs)
+        self.observe_compile(family, compile_s, flops, byts, variant=variant)
+
+    # -- invocation sampling -------------------------------------------
+    def observe_call(
+        self, family: str, seconds: float, variant: str = "", n: int = 1,
+    ) -> None:
+        st = self._programs.setdefault((family, variant), _ProgramStats())
+        st.calls += int(n)
+        st.device_s += float(seconds)
+        lab = dict(family=family, variant=variant)
+        self._c_calls.labels(**lab).inc(int(n))
+        self._c_device.labels(**lab).inc(float(seconds))
+        mfu = _measured_mfu(st, peak_flops_per_chip())
+        if mfu is not None:
+            self._g_mfu.labels(**lab).set(mfu)
+
+    # -- readout -------------------------------------------------------
+    def families(self) -> List[str]:
+        return sorted({fam for fam, _ in self._programs})
+
+    def rows(self) -> List[Dict[str, Any]]:
+        """One report row per (family, variant), sorted; roofline fields
+        derived against the peak tables (None off-TPU)."""
+        peak_f = peak_flops_per_chip()
+        peak_bw = peak_hbm_bytes_per_chip()
+        out = []
+        for (family, variant) in sorted(self._programs):
+            st = self._programs[(family, variant)]
+            ai = None
+            if st.flops is not None and st.bytes_accessed:
+                ai = st.flops / st.bytes_accessed
+            expected_mfu = None
+            if ai is not None and peak_f and peak_bw:
+                # roofline ceiling: compute-bound families saturate at 1,
+                # bandwidth-bound ones at intensity / machine-balance
+                expected_mfu = min(1.0, ai / (peak_f / peak_bw))
+            out.append({
+                "family": family,
+                "variant": variant,
+                "compiles": st.compiles,
+                "compile_s": st.compile_s,
+                "flops": st.flops,
+                "bytes_accessed": st.bytes_accessed,
+                "calls": st.calls,
+                "device_s": st.device_s,
+                "arith_intensity": ai,
+                "expected_mfu": expected_mfu,
+                "measured_mfu": _measured_mfu(st, peak_f),
+            })
+        return out
+
+
+def _measured_mfu(st: _ProgramStats, peak_f: Optional[float],
+                  ) -> Optional[float]:
+    if st.flops is None or not peak_f or st.device_s <= 0 or st.calls < 1:
+        return None
+    return (st.flops * st.calls / st.device_s) / peak_f
+
+
+# ---------------------------------------------------------------------
+# HBM ledger
+# ---------------------------------------------------------------------
+
+
+def tree_bytes(tree: Any) -> int:
+    """Analytic bytes of a pytree from shapes/dtypes alone — works on
+    device arrays, numpy arrays and ShapeDtypeStructs alike (no
+    device-side readout, so it is exact even for donated buffers)."""
+    import jax  # lazy: telemetry must import without a backend
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return int(total)
+
+
+def kv_cache_bytes(cfg: Any, n_slots: int, dtype: Any = None) -> int:
+    """Exact bytes of one slot-pool KV cache: the two
+    ``(n_layer, n_slots, block_size, kv_heads, head_dim)`` buffers of
+    ``models/generate.init_cache``."""
+    elems = (int(cfg.n_layer) * int(n_slots) * int(cfg.block_size)
+             * int(cfg.kv_heads) * int(cfg.head_dim))
+    itemsize = np.dtype(dtype if dtype is not None else cfg.dtype).itemsize
+    return 2 * elems * itemsize
+
+
+class HBMLedger:
+    """Bytes-by-owner HBM accounting plus the live-array leak audit.
+
+    ``account(owner, nbytes)`` is declarative (set, not add): owners
+    re-account as their pools change, and the ledger is the sum of the
+    latest declarations. ``audit()`` compares the owned total against
+    what the runtime actually holds (``jax.live_arrays()``) — a growing
+    unattributed residue is the leak signal the report is for.
+    """
+
+    def __init__(
+        self,
+        registry: Optional[MetricsRegistry] = None,
+        capacity_bytes: Optional[float] = None,
+    ):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.capacity_bytes = (capacity_bytes if capacity_bytes is not None
+                               else peak_hbm_capacity_per_chip())
+        self._owners: Dict[str, int] = {}
+        r = self.registry
+        self._g_owner = r.gauge(
+            "mingpt_attrib_hbm_bytes",
+            help="accounted HBM bytes by owner (shapes/dtypes, exact)",
+            labels=("owner",))
+        self._g_total = r.gauge(
+            "mingpt_attrib_hbm_total_bytes",
+            help="sum of accounted HBM bytes across owners")
+        self._g_live = r.gauge(
+            "mingpt_attrib_hbm_live_bytes",
+            help="bytes of all live jax arrays in this process")
+        self._g_unattr = r.gauge(
+            "mingpt_attrib_hbm_unattributed_bytes",
+            help="live bytes no owner accounts for (leak audit residue)")
+        self._g_headroom = r.gauge(
+            "mingpt_attrib_hbm_headroom_bytes",
+            help="chip HBM capacity minus accounted bytes "
+                 "(absent off-TPU: no capacity table row)")
+
+    def account(self, owner: str, nbytes: int) -> None:
+        if nbytes < 0:
+            raise ValueError(f"owner {owner!r}: negative bytes {nbytes}")
+        self._owners[owner] = int(nbytes)
+        self._g_owner.labels(owner=owner).set(int(nbytes))
+        total = self.total_bytes()
+        self._g_total.set(total)
+        if self.capacity_bytes is not None:
+            self._g_headroom.set(self.capacity_bytes - total)
+
+    def owners(self) -> Dict[str, int]:
+        return dict(sorted(self._owners.items()))
+
+    def total_bytes(self) -> int:
+        return sum(self._owners.values())
+
+    def live_bytes(self) -> int:
+        import jax  # lazy: telemetry must import without a backend
+
+        return sum(int(a.nbytes) for a in jax.live_arrays())
+
+    def audit(self) -> Dict[str, int]:
+        """Leak audit: owned vs live bytes. Process-level state (other
+        subsystems' arrays count as live), so the report excludes it by
+        default — it feeds the gauges and the selftest's leak check."""
+        owned = self.total_bytes()
+        live = self.live_bytes()
+        self._g_live.set(live)
+        self._g_unattr.set(max(0, live - owned))
+        return {
+            "owned_bytes": owned,
+            "live_bytes": live,
+            "unattributed_bytes": max(0, live - owned),
+        }
+
+
+# ---------------------------------------------------------------------
+# mingpt-attrib/1 report
+# ---------------------------------------------------------------------
+
+
+def build_attrib_report(
+    programs: ProgramLedger,
+    hbm: Optional[HBMLedger] = None,
+    include_live: bool = False,
+) -> Dict[str, Any]:
+    """Assemble the versioned report. ``include_live`` folds the
+    ``jax.live_arrays()`` audit in — off by default because live bytes
+    are process history, not run state, and would break the
+    byte-identical-reports property two sequential runs must have."""
+    report: Dict[str, Any] = {
+        "schema": ATTRIB_SCHEMA,
+        "programs": programs.rows(),
+        "peaks": {
+            "flops_per_chip": peak_flops_per_chip(),
+            "hbm_bandwidth_per_chip": peak_hbm_bytes_per_chip(),
+            "hbm_capacity_per_chip": peak_hbm_capacity_per_chip(),
+        },
+    }
+    if hbm is not None:
+        owners = hbm.owners()
+        total = hbm.total_bytes()
+        block: Dict[str, Any] = {
+            "owners": owners,
+            "total_bytes": total,
+            "capacity_bytes": hbm.capacity_bytes,
+            "headroom_bytes": (None if hbm.capacity_bytes is None
+                               else hbm.capacity_bytes - total),
+        }
+        if include_live:
+            block["audit"] = hbm.audit()
+        report["hbm"] = block
+    return report
+
+
+_PROGRAM_KEYS = {
+    "family": str, "variant": str, "compiles": int, "compile_s": float,
+    "flops": float, "bytes_accessed": float, "calls": int,
+    "device_s": float, "arith_intensity": float, "expected_mfu": float,
+    "measured_mfu": float,
+}
+_NULLABLE = {"flops", "bytes_accessed", "arith_intensity",
+             "expected_mfu", "measured_mfu"}
+
+
+def validate_attrib_report(report: Dict[str, Any]) -> None:
+    """Strict structural validation (raises ValueError). The shape every
+    consumer (perf_diff, trace_summary, the /attrib scrape assertions)
+    can then rely on without defensive re-checking."""
+    if report.get("schema") != ATTRIB_SCHEMA:
+        raise ValueError(
+            f"not a {ATTRIB_SCHEMA} report: schema={report.get('schema')!r}")
+    progs = report.get("programs")
+    if not isinstance(progs, list):
+        raise ValueError("programs must be a list")
+    seen = set()
+    for i, row in enumerate(progs):
+        if not isinstance(row, dict):
+            raise ValueError(f"programs[{i}] is not an object")
+        missing = set(_PROGRAM_KEYS) - set(row)
+        if missing:
+            raise ValueError(f"programs[{i}] missing {sorted(missing)}")
+        for key, typ in _PROGRAM_KEYS.items():
+            v = row[key]
+            if v is None:
+                if key in _NULLABLE:
+                    continue
+                raise ValueError(f"programs[{i}].{key} must not be null")
+            if typ is float and isinstance(v, int):
+                v = float(v)
+            if not isinstance(v, typ) or isinstance(v, bool):
+                raise ValueError(
+                    f"programs[{i}].{key}={v!r} is not {typ.__name__}")
+        if row["compiles"] < 0 or row["calls"] < 0 or row["compile_s"] < 0 \
+                or row["device_s"] < 0:
+            raise ValueError(f"programs[{i}] has negative accounting")
+        key = (row["family"], row["variant"])
+        if key in seen:
+            raise ValueError(f"duplicate program row {key}")
+        seen.add(key)
+    hbm = report.get("hbm")
+    if hbm is not None:
+        owners = hbm.get("owners")
+        if not isinstance(owners, dict):
+            raise ValueError("hbm.owners must be an object")
+        for owner, nb in owners.items():
+            if not isinstance(nb, int) or isinstance(nb, bool) or nb < 0:
+                raise ValueError(f"hbm.owners[{owner!r}]={nb!r} is not a "
+                                 f"non-negative integer")
+        if hbm.get("total_bytes") != sum(owners.values()):
+            raise ValueError(
+                f"hbm.total_bytes={hbm.get('total_bytes')!r} != sum of "
+                f"owners {sum(owners.values())}")
+    peaks = report.get("peaks")
+    if not isinstance(peaks, dict):
+        raise ValueError("peaks must be an object")
+
+
+def dump_attrib_report(report: Dict[str, Any]) -> str:
+    """Canonical serialisation: sorted keys, fixed separators — the
+    byte-identity contract of the VirtualClock selftest."""
+    return json.dumps(report, sort_keys=True, indent=2)
+
+
+def render_attrib_report(report: Dict[str, Any]) -> str:
+    """Human-readable per-family table (stable layout, render_slo_diff
+    column idiom)."""
+
+    def _cell(v: Optional[float]) -> str:
+        return "n/a" if v is None else f"{v:.4g}"
+
+    lines = [f"Attribution report ({report['schema']}): "
+             f"{len(report['programs'])} program rows"]
+    lines.append(
+        f"  {'family':<16} {'variant':<10} {'flops':>10} {'bytes':>10} "
+        f"{'compile_s':>10} {'calls':>7} {'device_s':>10} {'mfu':>8}")
+    for row in report["programs"]:
+        lines.append(
+            f"  {row['family']:<16} {row['variant']:<10} "
+            f"{_cell(row['flops']):>10} {_cell(row['bytes_accessed']):>10} "
+            f"{row['compile_s']:>10.4g} {row['calls']:>7} "
+            f"{row['device_s']:>10.4g} {_cell(row['measured_mfu']):>8}")
+    hbm = report.get("hbm")
+    if hbm:
+        lines.append(f"  HBM: total {hbm['total_bytes']} bytes"
+                     + ("" if hbm.get("headroom_bytes") is None else
+                        f", headroom {hbm['headroom_bytes']:.3g}"))
+        for owner, nb in hbm["owners"].items():
+            lines.append(f"    {owner:<20} {nb:>14}")
+    return "\n".join(lines)

@@ -37,6 +37,7 @@ __all__ = [
     "TelemetryServer",
     "parse_prometheus",
     "register_build_info",
+    "render_fleet_prometheus",
     "render_prometheus",
 ]
 
@@ -132,6 +133,62 @@ def render_prometheus(registry: MetricsRegistry) -> str:
                 out.append(_sample(fam.name + "_count", labels, child.count))
             else:
                 out.append(_sample(fam.name, labels, child.value))
+    return "\n".join(out) + "\n"
+
+
+def render_fleet_prometheus(
+    base_registry: Optional[MetricsRegistry],
+    replica_registries: Dict[str, MetricsRegistry],
+) -> str:
+    """Fleet-wide merged exposition (ISSUE 13): the union of N
+    per-replica registries under an injected ``replica`` label, plus an
+    optional base registry (router/supervisor-level families) emitted
+    unlabeled — one scrape covers the whole fleet.
+
+    Families sharing a name across replicas merge under ONE HELP/TYPE
+    header (the strict parser rejects duplicate TYPE lines, so the merge
+    must not naively concatenate pages); a name registered with two
+    different instrument kinds anywhere in the fleet raises — the same
+    contract ``MetricsRegistry`` enforces within one process. Output
+    order is sorted family names then sorted replica names:
+    byte-deterministic for identical registry states."""
+    sources: List[Tuple[Optional[str], MetricsRegistry]] = []
+    if base_registry is not None:
+        sources.append((None, base_registry))
+    sources.extend(sorted(replica_registries.items()))
+    fams: Dict[str, List[Tuple[Optional[str], Any]]] = {}
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    for replica, reg in sources:
+        for fam in reg.collect():
+            prev = kinds.get(fam.name)
+            if prev is not None and prev != fam.kind:
+                raise ValueError(
+                    f"fleet merge: family {fam.name!r} is {fam.kind} on "
+                    f"{replica or 'base'} but {prev} elsewhere — exposition "
+                    f"would be incoherent")
+            kinds[fam.name] = fam.kind
+            if fam.help and fam.name not in helps:
+                helps[fam.name] = fam.help
+            fams.setdefault(fam.name, []).append((replica, fam))
+    out: List[str] = []
+    for name in sorted(fams):
+        if helps.get(name):
+            out.append(f"# HELP {name} {_escape_help(helps[name])}")
+        out.append(f"# TYPE {name} {kinds[name]}")
+        for replica, fam in fams[name]:
+            for labels, child in fam.children():
+                if replica is not None:
+                    labels = {"replica": replica, **labels}
+                if fam.kind == "histogram":
+                    for upper, cum in child.cumulative():
+                        le = "+Inf" if upper == float("inf") else _fmt(upper)
+                        out.append(_sample(
+                            name + "_bucket", {**labels, "le": le}, cum))
+                    out.append(_sample(name + "_sum", labels, child.sum))
+                    out.append(_sample(name + "_count", labels, child.count))
+                else:
+                    out.append(_sample(name, labels, child.value))
     return "\n".join(out) + "\n"
 
 
@@ -305,13 +362,19 @@ class TelemetryServer:
     ephemeral port (exposed as ``.port``) — what the CI smoke uses so
     parallel runs never collide.
 
-    ``health_provider`` / ``flight_provider`` are settable attributes
-    (read per request, so they can be wired after backend
-    construction): the former returns a dict merged into the healthz
-    document — serve.py wires ``Router.health_report`` so /healthz
-    carries per-replica breaker state and health-gate reasons (ISSUE
-    10) — the latter returns a flight snapshot document; without one,
-    ``/debug/flight`` is 404."""
+    ``health_provider`` / ``flight_provider`` / ``attrib_provider`` /
+    ``metrics_provider`` are settable attributes (read per request, so
+    they can be wired after backend construction): ``health_provider``
+    returns a dict merged into the healthz document — serve.py wires
+    ``Router.health_report`` so /healthz carries per-replica breaker
+    state and health-gate reasons (ISSUE 10) — ``flight_provider``
+    returns a flight snapshot document (without one ``/debug/flight``
+    is 404), ``attrib_provider`` returns the mingpt-attrib/1 (or
+    fleet-wrapped) performance-attribution report served as JSON on
+    ``/attrib`` (404 without one — ISSUE 13), and ``metrics_provider``
+    overrides the ``/metrics`` body — the fleet router installs
+    ``render_fleet_prometheus`` over the per-replica registries here so
+    one scrape covers every replica under a ``replica`` label."""
 
     def __init__(
         self,
@@ -320,10 +383,14 @@ class TelemetryServer:
         host: str = "127.0.0.1",
         health_provider=None,
         flight_provider=None,
+        attrib_provider=None,
+        metrics_provider=None,
     ):
         self.registry = registry
         self.health_provider = health_provider
         self.flight_provider = flight_provider
+        self.attrib_provider = attrib_provider
+        self.metrics_provider = metrics_provider
         self._t0 = time.time()
         outer = self
 
@@ -331,8 +398,23 @@ class TelemetryServer:
             def do_GET(self) -> None:  # noqa: N802 — stdlib contract
                 path = self.path.split("?", 1)[0]
                 if path == "/metrics":
-                    body = render_prometheus(outer.registry).encode()
+                    mp = outer.metrics_provider
+                    page = (render_prometheus(outer.registry)
+                            if mp is None else mp())
+                    body = page.encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/attrib":
+                    ap = outer.attrib_provider
+                    if ap is None:
+                        self.send_error(
+                            404, "no attribution ledger configured")
+                        return
+                    try:
+                        doc = ap()
+                    except Exception as e:
+                        doc = {"error": repr(e)}
+                    body = json.dumps(doc, sort_keys=True).encode()
+                    ctype = "application/json"
                 elif path == "/healthz":
                     doc = {
                         "status": "ok",

@@ -12,8 +12,10 @@ from typing import Dict, Optional
 __all__ = [
     "PEAK_FLOPS",
     "PEAK_HBM_BYTES",
+    "PEAK_HBM_CAPACITY",
     "peak_flops_per_chip",
     "peak_hbm_bytes_per_chip",
+    "peak_hbm_capacity_per_chip",
 ]
 
 # Peak dense bf16 FLOP/s per chip, for MFU.
@@ -49,6 +51,22 @@ PEAK_HBM_BYTES: Dict[str, float] = {
 }
 
 
+# HBM capacity per chip (bytes) — the ceiling the attribution layer's
+# headroom gauge reports against (telemetry/attribution.py), distinct
+# from the PEAK_HBM_BYTES *bandwidth* table above.
+PEAK_HBM_CAPACITY: Dict[str, float] = {
+    "TPU v4": 32e9,
+    "TPU v5 lite": 16e9,  # v5e
+    "TPU v5e": 16e9,
+    "TPU v5p": 95e9,
+    "TPU v5": 95e9,  # v5p (bare "TPU v5" device_kind spelling)
+    "TPU v6 lite": 32e9,  # v6e (Trillium)
+    "TPU v6e": 32e9,
+    "TPU v7x": 192e9,
+    "TPU v7": 192e9,  # Ironwood
+}
+
+
 def _chip_lookup(table: Dict[str, float]) -> Optional[float]:
     # longest-prefix-wins by dict order (see the ordering note above)
     import jax  # lazy: the telemetry package must import without a backend
@@ -66,3 +84,7 @@ def peak_flops_per_chip() -> Optional[float]:
 
 def peak_hbm_bytes_per_chip() -> Optional[float]:
     return _chip_lookup(PEAK_HBM_BYTES)
+
+
+def peak_hbm_capacity_per_chip() -> Optional[float]:
+    return _chip_lookup(PEAK_HBM_CAPACITY)

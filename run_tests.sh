@@ -135,6 +135,39 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python tools/trace_summary.py \
         --compare "$OBS_DIR/slo.json" "$OBS_DIR/slo.json" > /dev/null
 
+# Performance-attribution gate (ISSUE 13): every lifetime-compiled
+# program family (prefill buckets, decode, spec verify, draft, train
+# step) must appear in the strict-validated mingpt-attrib/1 report with
+# nonzero cost_analysis FLOPs and a compile time; the HBM ledger's
+# pool owners must match live device bytes within 1%; two runs on the
+# deterministic clock must dump byte-identical reports with
+# tools/perf_diff.py finding zero regressions between them; /attrib and
+# the fleet-merged /metrics page (per-replica mingpt_attrib_* samples
+# under the replica label) must scrape strict-valid.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-attrib --prefill-chunk 8 \
+        --prefill-buckets 8,16,32 --prefix-cache-mb 0.5 --warmup \
+        --attrib-json "$OBS_DIR/attrib.json"
+
+# The attribution artifacts round-trip through the offline tools:
+# trace_summary renders the per-family flops/bytes/compile table from
+# the report the gate just wrote, and perf_diff runs both of its input
+# kinds — the attrib report against itself (all-"same") and two real
+# bench.py reports (noise-aware verdicts; exit 1 only on a regression).
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python tools/trace_summary.py "$OBS_DIR/attrib.json" > /dev/null
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    python tools/perf_diff.py \
+        "$OBS_DIR/attrib.json" "$OBS_DIR/attrib.json" > /dev/null
+if ls BENCH_r*.json > /dev/null 2>&1; then
+  benches=(BENCH_r*.json)
+  env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+      python tools/perf_diff.py \
+          "${benches[0]}" "${benches[-1]}" > /dev/null
+fi
+
 # Traffic-lab gate (ISSUE 12): a canned FIFO-vs-EDF load sweep on the
 # virtual clock — strict mingpt-traffic/1 validation after a JSON
 # round-trip, a valid knee (SLO passes at the rung below, fails at the

@@ -1,0 +1,443 @@
+"""Performance attribution (ISSUE 13): program/HBM ledgers, the
+mingpt-attrib/1 report contract (validate/dump/render), the fleet-wide
+merged scrape, the zero-aware HBM entries, the noise-aware perf_diff
+verdicts, and the Histogram.quantile-vs-exact_quantile bound
+cross-check.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+from mingpt_distributed_tpu import telemetry
+from mingpt_distributed_tpu.telemetry import (
+    ATTRIB_SCHEMA,
+    HBMLedger,
+    MetricsRegistry,
+    ProgramLedger,
+    build_attrib_report,
+    dump_attrib_report,
+    kv_cache_bytes,
+    parse_prometheus,
+    render_attrib_report,
+    render_fleet_prometheus,
+    tree_bytes,
+    validate_attrib_report,
+)
+from mingpt_distributed_tpu.telemetry import attribution
+from mingpt_distributed_tpu.telemetry.slo import exact_quantile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import perf_diff  # noqa: E402
+
+
+class TickingClock:
+    """Deterministic clock: each read advances by a fixed quantum."""
+
+    def __init__(self, dt=1.0):
+        self.t = 0.0
+        self.dt = dt
+
+    def __call__(self):
+        self.t += self.dt
+        return self.t
+
+
+# ---------------------------------------------------------------------------
+# ProgramLedger
+# ---------------------------------------------------------------------------
+
+
+def test_program_ledger_accumulates_and_sorts_rows():
+    led = ProgramLedger(registry=MetricsRegistry())
+    led.observe_compile("prefill", 0.5, 100.0, 50.0, variant="b16")
+    led.observe_compile("decode", 0.25, 10.0, 40.0)
+    led.observe_compile("prefill", 0.5, 200.0, 80.0, variant="b8")
+    led.observe_call("decode", 0.01, n=3)
+    led.observe_call("decode", 0.02)
+    assert led.families() == ["decode", "prefill"]
+    rows = {(r["family"], r["variant"]): r for r in led.rows()}
+    assert [(r["family"], r["variant"]) for r in led.rows()] == sorted(rows)
+    dec = rows[("decode", "")]
+    assert dec["compiles"] == 1 and dec["compile_s"] == 0.25
+    assert dec["calls"] == 4
+    assert dec["device_s"] == pytest.approx(0.03)
+    assert dec["arith_intensity"] == pytest.approx(0.25)
+    # registered but never invoked: visible with zero calls
+    assert rows[("prefill", "b8")]["calls"] == 0
+
+
+def test_program_ledger_keeps_latest_non_none_cost():
+    led = ProgramLedger(registry=MetricsRegistry())
+    led.observe_compile("decode", 0.1, 10.0, 20.0)
+    # a re-registration without a cost model must not erase the reading
+    led.observe_compile("decode", 0.1, None, None)
+    [row] = led.rows()
+    assert row["compiles"] == 2
+    assert row["compile_s"] == pytest.approx(0.2)
+    assert row["flops"] == 10.0 and row["bytes_accessed"] == 20.0
+
+
+def test_program_ledger_feeds_registry_gauges():
+    reg = MetricsRegistry()
+    led = ProgramLedger(registry=reg)
+    led.observe_compile("verify", 0.5, 99.0, 11.0, variant="k3")
+    led.observe_call("verify", 0.25, variant="k3", n=2)
+    parsed = parse_prometheus(telemetry.render_prometheus(reg))
+    assert parsed["types"]["mingpt_attrib_flops"] == "gauge"
+    assert parsed["types"]["mingpt_attrib_calls_total"] == "counter"
+    values = {(n, tuple(sorted(l.items()))): v
+              for n, l, v in parsed["samples"]}
+    lab = (("family", "verify"), ("variant", "k3"))
+    assert values[("mingpt_attrib_flops", lab)] == 99.0
+    assert values[("mingpt_attrib_calls_total", lab)] == 2
+    assert values[("mingpt_attrib_device_seconds_total", lab)] == 0.25
+
+
+def test_roofline_fields_against_injected_peaks(monkeypatch):
+    """expected_mfu = min(1, intensity / machine-balance); measured_mfu
+    = achieved flops-rate over peak. Pinned with synthetic peaks so the
+    math is testable off-TPU (the real tables return None on CPU)."""
+    monkeypatch.setattr(attribution, "peak_flops_per_chip", lambda: 100.0)
+    monkeypatch.setattr(attribution, "peak_hbm_bytes_per_chip", lambda: 50.0)
+    led = ProgramLedger(registry=MetricsRegistry())
+    # bandwidth-bound: intensity 1 flop/byte vs machine balance 2
+    led.observe_compile("decode", 0.1, 40.0, 40.0)
+    led.observe_call("decode", 2.0, n=2)  # 40 flops/s achieved
+    # compute-bound: intensity 10 >> balance 2, ceiling clips at 1
+    led.observe_compile("prefill", 0.1, 400.0, 40.0)
+    rows = {r["family"]: r for r in led.rows()}
+    assert rows["decode"]["expected_mfu"] == pytest.approx(0.5)
+    assert rows["decode"]["measured_mfu"] == pytest.approx(0.4)
+    assert rows["prefill"]["expected_mfu"] == 1.0
+    assert rows["prefill"]["measured_mfu"] is None  # never invoked
+
+
+def test_register_aot_times_compile_on_injected_clock():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    fn = jax.jit(lambda x: x @ x)
+    aval = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    led = ProgramLedger(registry=MetricsRegistry())
+    led.register_aot("matmul", fn, (aval,), TickingClock())
+    [row] = led.rows()
+    # exactly two clock reads bracket the compile: 2.0 - 1.0
+    assert row["compile_s"] == pytest.approx(1.0)
+    assert row["flops"] and row["flops"] > 0  # CPU cost model works
+    assert row["bytes_accessed"] and row["bytes_accessed"] > 0
+    # AOT lowering must not populate the jit call cache (the recompile
+    # watchdog's counter) — registration next to an armed watchdog is free
+    assert fn._cache_size() == 0
+
+
+# ---------------------------------------------------------------------------
+# HBMLedger
+# ---------------------------------------------------------------------------
+
+
+def test_hbm_ledger_is_declarative_and_sorted():
+    led = HBMLedger(registry=MetricsRegistry(), capacity_bytes=1000)
+    led.account("params", 300)
+    led.account("kv_pool", 200)
+    led.account("kv_pool", 250)  # set, not add
+    assert led.owners() == {"kv_pool": 250, "params": 300}
+    assert list(led.owners()) == ["kv_pool", "params"]
+    assert led.total_bytes() == 550
+    with pytest.raises(ValueError, match="negative"):
+        led.account("params", -1)
+
+
+def test_hbm_ledger_headroom_gauge():
+    reg = MetricsRegistry()
+    led = HBMLedger(registry=reg, capacity_bytes=1000)
+    led.account("params", 600)
+    parsed = parse_prometheus(telemetry.render_prometheus(reg))
+    values = {(n, tuple(sorted(l.items()))): v
+              for n, l, v in parsed["samples"]}
+    assert values[("mingpt_attrib_hbm_bytes", (("owner", "params"),))] == 600
+    assert values[("mingpt_attrib_hbm_total_bytes", ())] == 600
+    assert values[("mingpt_attrib_hbm_headroom_bytes", ())] == 400
+
+
+def test_hbm_audit_reports_unattributed_live_bytes():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    keep = jnp.ones((64,), jnp.float32)  # ensure something is live
+    led = HBMLedger(registry=MetricsRegistry(), capacity_bytes=None)
+    audit = led.audit()
+    assert audit["owned_bytes"] == 0
+    assert audit["live_bytes"] >= int(keep.nbytes)
+    assert audit["unattributed_bytes"] == audit["live_bytes"]
+    led.account("keep", int(keep.nbytes))
+    audit = led.audit()
+    assert audit["unattributed_bytes"] == audit["live_bytes"] - keep.nbytes
+
+
+def test_tree_bytes_and_kv_cache_bytes_match_real_buffers():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models.generate import init_cache
+
+    tree = {"w": jnp.ones((4, 4), jnp.float32),
+            "b": jax.ShapeDtypeStruct((4,), jnp.bfloat16)}
+    assert tree_bytes(tree) == 4 * 4 * 4 + 4 * 2
+
+    cfg = GPTConfig.make(n_layer=2, n_head=2, n_embd=32, vocab_size=64,
+                         block_size=16, dtype="float32")
+    cache = init_cache(cfg, batch=3)
+    assert kv_cache_bytes(cfg, n_slots=3) == sum(
+        int(a.nbytes) for a in jax.tree.leaves(cache))
+
+
+def test_opt_moment_bytes_dense_is_two_param_copies():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+
+    from mingpt_distributed_tpu.parallel.zero import opt_moment_bytes
+
+    params = {"w": jax.ShapeDtypeStruct((8, 4), jnp.float32),
+              "b": jax.ShapeDtypeStruct((4,), jnp.float32)}
+    assert opt_moment_bytes(params, None) == 2 * tree_bytes(params)
+
+
+# ---------------------------------------------------------------------------
+# mingpt-attrib/1 report
+# ---------------------------------------------------------------------------
+
+
+def _tiny_report(with_hbm=True):
+    led = ProgramLedger(registry=MetricsRegistry())
+    led.observe_compile("decode", 0.1, 10.0, 20.0)
+    led.observe_call("decode", 0.05, n=2)
+    hbm = None
+    if with_hbm:
+        hbm = HBMLedger(registry=MetricsRegistry(), capacity_bytes=1000)
+        hbm.account("params", 300)
+    return build_attrib_report(led, hbm=hbm)
+
+
+def test_report_roundtrip_validate_dump_render():
+    rep = _tiny_report()
+    validate_attrib_report(rep)
+    # json round-trip preserves validity (the consumer-side path)
+    rep2 = json.loads(dump_attrib_report(rep))
+    validate_attrib_report(rep2)
+    assert dump_attrib_report(rep2) == dump_attrib_report(rep)
+    text = render_attrib_report(rep)
+    assert "1 program rows" in text
+    assert "decode" in text and "params" in text
+    assert rep["hbm"]["headroom_bytes"] == 700
+
+
+def test_identically_built_ledgers_dump_identical_bytes():
+    assert dump_attrib_report(_tiny_report()) == \
+        dump_attrib_report(_tiny_report())
+
+
+@pytest.mark.parametrize("mutate,match", [
+    (lambda r: r.update(schema="nope/9"), "schema"),
+    (lambda r: r["programs"][0].pop("flops"), "missing"),
+    (lambda r: r["programs"][0].update(calls=-1), "negative"),
+    (lambda r: r["programs"].append(dict(r["programs"][0])), "duplicate"),
+    (lambda r: r["hbm"].update(total_bytes=1), "total_bytes"),
+    (lambda r: r["hbm"]["owners"].update(params=-5), "non-negative"),
+    (lambda r: r["programs"][0].update(compile_s=None), "null"),
+])
+def test_validate_rejects_malformed_reports(mutate, match):
+    rep = _tiny_report()
+    mutate(rep)
+    with pytest.raises(ValueError, match=match):
+        validate_attrib_report(rep)
+
+
+# ---------------------------------------------------------------------------
+# fleet-wide merged scrape
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_merge_one_type_line_per_family_with_replica_label():
+    regs = {}
+    for name in ("replica0", "replica1"):
+        reg = MetricsRegistry()
+        led = ProgramLedger(registry=reg)
+        led.observe_compile("decode", 0.1, 10.0, 20.0)
+        led.observe_call("decode", 0.01)
+        regs[name] = reg
+    base = MetricsRegistry()
+    base.gauge("mingpt_fleet_replica_up", labels=("replica",)) \
+        .labels(replica="replica0").set(1)
+    page = render_fleet_prometheus(base, regs)
+    # strict parse implies no duplicate TYPE lines survived the merge
+    parsed = parse_prometheus(page)
+    assert page.count("# TYPE mingpt_attrib_flops gauge") == 1
+    per_replica = sorted(
+        l["replica"] for n, l, _ in parsed["samples"]
+        if n == "mingpt_attrib_flops")
+    assert per_replica == ["replica0", "replica1"]
+    # base-registry families stay unlabeled-by-replica-injection
+    assert ("mingpt_fleet_replica_up", {"replica": "replica0"}, 1.0) \
+        in parsed["samples"]
+
+
+def test_fleet_merge_rejects_cross_replica_kind_conflict():
+    a, b = MetricsRegistry(), MetricsRegistry()
+    a.counter("mingpt_test_thing_total")
+    b.gauge("mingpt_test_thing_total")
+    with pytest.raises(ValueError, match="incoherent"):
+        render_fleet_prometheus(None, {"replica0": a, "replica1": b})
+
+
+def test_fleet_merge_page_is_deterministic():
+    def build():
+        regs = {}
+        for name in ("r1", "r0"):
+            reg = MetricsRegistry()
+            ProgramLedger(registry=reg).observe_compile(
+                "decode", 0.5, 1.0, 2.0)
+            regs[name] = reg
+        return render_fleet_prometheus(None, regs)
+
+    assert build() == build()
+
+
+# ---------------------------------------------------------------------------
+# perf_diff verdicts
+# ---------------------------------------------------------------------------
+
+
+def _perturb(rep, family, **changes):
+    rep = json.loads(json.dumps(rep))
+    for row in rep["programs"]:
+        if row["family"] == family:
+            row.update(changes)
+    return rep
+
+
+def test_perf_diff_self_is_all_same():
+    rep = _tiny_report(with_hbm=False)
+    diff = perf_diff.diff_attrib_reports(rep, rep)
+    assert diff["regressions"] == 0
+    assert all(r["verdict"] == "same" for r in diff["programs"])
+
+
+def test_perf_diff_timing_noise_needs_both_gates():
+    rep = _tiny_report(with_hbm=False)
+    # +40% relative but under the 1ms absolute floor: noise
+    small = _perturb(rep, "decode", compile_s=0.1 + 4e-4)
+    assert perf_diff.diff_attrib_reports(
+        rep, small, rel_tol=0.05, abs_floor_s=1e-3)["regressions"] == 0
+    # clears both gates: a real compile-time regression
+    big = _perturb(rep, "decode", compile_s=0.2)
+    diff = perf_diff.diff_attrib_reports(rep, big)
+    assert diff["regressions"] == 1
+    [row] = diff["programs"]
+    assert row["metrics"]["compile_s"]["verdict"] == "regressed"
+    # the same swing in the other direction reads as an improvement
+    diff = perf_diff.diff_attrib_reports(big, rep)
+    assert diff["regressions"] == 0
+    assert diff["programs"][0]["verdict"] == "improved"
+
+
+def test_perf_diff_exact_metrics_have_no_noise_allowance():
+    rep = _tiny_report(with_hbm=False)
+    drift = _perturb(rep, "decode", flops=10.5)  # +5%: would pass rel_tol
+    diff = perf_diff.diff_attrib_reports(rep, drift)
+    assert diff["programs"][0]["metrics"]["flops"]["verdict"] == "regressed"
+
+
+def test_perf_diff_unmatched_family_is_na_not_regression():
+    rep_a = _tiny_report(with_hbm=False)
+    led = ProgramLedger(registry=MetricsRegistry())
+    led.observe_compile("prefill", 0.1, 5.0, 5.0, variant="b8")
+    rep_b = build_attrib_report(led)
+    diff = perf_diff.diff_attrib_reports(rep_a, rep_b)
+    assert diff["regressions"] == 0
+    assert {r["verdict"] for r in diff["programs"]} == {"n/a"}
+
+
+def test_perf_diff_bench_direction_and_null_handling():
+    def bench(value, metric="decode tok/s/device"):
+        return {"n": 1, "cmd": "bench", "rc": 0, "tail": "",
+                "parsed": {"metric": metric, "value": value,
+                           "unit": "tok/s", "vs_baseline": None,
+                           "error": None}}
+
+    # higher-is-better metric dropping is a regression
+    diff = perf_diff.diff_bench_reports(bench(100.0), bench(50.0))
+    assert diff["regressions"] == 1
+    assert diff["metrics"][0]["direction"] == "higher_better"
+    # latency-ish name flips the direction
+    diff = perf_diff.diff_bench_reports(
+        bench(1.0, "itl_seconds"), bench(2.0, "itl_seconds"))
+    assert diff["metrics"][0]["direction"] == "lower_better"
+    assert diff["regressions"] == 1
+    # a null value (no backend) is n/a, never a regression
+    diff = perf_diff.diff_bench_reports(bench(100.0), bench(None))
+    assert diff["regressions"] == 0
+    assert diff["metrics"][0]["verdict"] == "n/a"
+    # a failed round has no parsed block at all: still a bench record
+    failed = {"n": 1, "cmd": "bench", "rc": 1, "tail": "boom"}
+    assert perf_diff.classify("f.json", failed) == "bench"
+    diff = perf_diff.diff_bench_reports(failed, bench(100.0))
+    assert diff["regressions"] == 0
+    assert diff["metrics"][0]["verdict"] == "n/a"
+
+
+def test_perf_diff_cli_exit_codes(tmp_path):
+    rep = _tiny_report(with_hbm=False)
+    a = tmp_path / "a.json"
+    a.write_text(dump_attrib_report(rep))
+    b = tmp_path / "b.json"
+    b.write_text(dump_attrib_report(
+        _perturb(rep, "decode", compile_s=5.0)))
+    garbage = tmp_path / "c.json"
+    garbage.write_text(json.dumps({"schema": "what/9"}))
+    assert perf_diff.main([str(a), str(a)]) == 0
+    assert perf_diff.main([str(a), str(b)]) == 1
+    assert perf_diff.main([str(a), str(garbage)]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Histogram.quantile vs exact_quantile (satellite cross-check)
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_quantile_upper_bounds_exact_quantile():
+    """Histogram.quantile returns the smallest bucket upper bound
+    reaching the target rank — by construction >= the exact nearest-rank
+    quantile of the same samples. Replica.health()'s ITL p99 gate rides
+    this bias: a replica is flagged slow no later than its true
+    quantile crossing the threshold, never later."""
+    reg = MetricsRegistry()
+    h = reg.histogram("mingpt_test_itl_seconds",
+                      buckets=(0.01, 0.05, 0.1, 0.5, 1.0))
+    # deterministic sample spread across buckets, incl. boundary hits
+    samples = [0.004, 0.01, 0.02, 0.03, 0.05, 0.07, 0.09, 0.1,
+               0.2, 0.3, 0.42, 0.5, 0.61, 0.75, 0.99, 1.0]
+    for v in samples:
+        h.observe(v)
+    for q in (0.01, 0.25, 0.5, 0.9, 0.95, 0.99, 1.0):
+        exact = exact_quantile(samples, q)
+        est = h.quantile(q)
+        assert est >= exact, (q, est, exact)
+    # a sample past the ladder pushes high quantiles to +Inf — still an
+    # upper bound on the exact value
+    h.observe(7.0)
+    assert h.quantile(1.0) == float("inf")
+    assert h.quantile(1.0) >= exact_quantile(samples + [7.0], 1.0)
+
+
+def test_histogram_quantile_tight_when_samples_sit_on_bounds():
+    reg = MetricsRegistry()
+    h = reg.histogram("mingpt_test_tight_seconds", buckets=(1.0, 2.0, 4.0))
+    for v in (1.0, 2.0, 2.0, 4.0):
+        h.observe(v)
+        # every sample IS a bucket bound: the estimate is exact
+    for q in (0.25, 0.5, 0.75, 1.0):
+        assert h.quantile(q) == exact_quantile([1.0, 2.0, 2.0, 4.0], q)

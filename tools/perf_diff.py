@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Noise-aware perf-regression diff between two attribution or bench
+reports (ISSUE 13 satellite).
+
+Usage: python tools/perf_diff.py A.json B.json [--rel-tol F]
+           [--abs-floor-s S] [--json OUT]
+
+Input kinds (both files must be the same kind):
+
+* ``mingpt-attrib/1`` reports (``serve.py --attrib-json``): rows are
+  matched per program family+variant, and four per-program metrics are
+  compared — ``flops`` and ``bytes_accessed`` (exact program
+  properties; any drift beyond float noise is a real program change)
+  plus ``compile_s`` and ``device_s_per_call`` (timing: noisy, so a
+  relative tolerance AND an absolute floor must both be exceeded
+  before a delta counts). All four are lower-is-better.
+* ``bench.py`` reports (the repo's ``BENCH_r*.json``): the single
+  ``parsed`` metric is compared by name; direction is inferred from
+  the metric name (latency-ish names are lower-is-better, mfu /
+  throughput higher-is-better). A null value (no backend) or a failed
+  round with no ``parsed`` block renders as n/a, never as a
+  regression.
+
+Verdicts per metric: ``same`` | ``improved`` | ``regressed`` | ``n/a``
+(the ``diff_slo_reports`` vocabulary, with ``improved`` instead of
+``fixed`` because there is no pass/fail threshold here — only
+direction). Exit status: 0 when nothing regressed, 1 when anything
+did, 2 on malformed input — so two same-seed VirtualClock serving runs
+(byte-identical timings) gate cleanly in run_tests.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+ATTRIB_SCHEMA = "mingpt-attrib/1"
+
+#: attrib metrics compared per program row, in render order. The bool
+#: is "timing?": timing metrics get the noise thresholds, exact ones
+#: only float-epsilon slack.
+_ATTRIB_METRICS = (
+    ("flops", False),
+    ("bytes_accessed", False),
+    ("compile_s", True),
+    ("device_s_per_call", True),
+)
+
+#: substrings marking a bench metric as lower-is-better
+_LOWER_BETTER_HINTS = ("latency", "seconds", "time", "itl", "ttft")
+
+
+def _telemetry():
+    """Import the repo's telemetry package (validator lives there, not
+    here); running this file directly puts tools/ on sys.path, so fall
+    back to the tool's parent directory."""
+    try:
+        from mingpt_distributed_tpu import telemetry
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from mingpt_distributed_tpu import telemetry
+    return telemetry
+
+
+def classify(path: str, doc: Any) -> str:
+    """'attrib' | 'bench' (ValueError otherwise)."""
+    if isinstance(doc, dict) and doc.get("schema") == ATTRIB_SCHEMA:
+        return "attrib"
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict) \
+            and "metric" in doc["parsed"]:
+        return "bench"
+    # a failed bench round (rc != 0) has no "parsed" block but is still
+    # a bench record — diff it as n/a, don't reject the file
+    if isinstance(doc, dict) and {"n", "cmd", "rc", "tail"} <= set(doc):
+        return "bench"
+    schema = doc.get("schema") if isinstance(doc, dict) else None
+    raise ValueError(
+        f"{path}: neither a {ATTRIB_SCHEMA} report nor a bench.py "
+        f"report (schema={schema!r})")
+
+
+def _verdict(
+    a: Optional[float],
+    b: Optional[float],
+    rel_tol: float,
+    abs_floor: float,
+    lower_better: bool = True,
+) -> Dict[str, Any]:
+    """One metric's delta + verdict. A delta only counts when it clears
+    BOTH the relative tolerance (vs the baseline magnitude) and the
+    absolute floor — a 30% swing on a 2 microsecond compile is noise, a
+    30% swing on 3 seconds is not."""
+    if a is None or b is None:
+        return {"a": a, "b": b, "delta": None, "verdict": "n/a"}
+    delta = b - a
+    gate = max(rel_tol * abs(a), abs_floor)
+    if abs(delta) <= gate:
+        verdict = "same"
+    elif (delta > 0) == lower_better:
+        verdict = "regressed"
+    else:
+        verdict = "improved"
+    return {"a": a, "b": b, "delta": delta, "verdict": verdict}
+
+
+def diff_attrib_reports(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    rel_tol: float = 0.05,
+    abs_floor_s: float = 1e-3,
+) -> Dict[str, Any]:
+    """Per-program-family diff of two mingpt-attrib/1 reports."""
+    tel = _telemetry()
+    for label, rep in (("a", a), ("b", b)):
+        try:
+            tel.validate_attrib_report(rep)
+        except ValueError as e:
+            raise ValueError(f"report {label}: {e}") from None
+
+    def _rows(rep):
+        out = {}
+        for row in rep["programs"]:
+            r = dict(row)
+            r["device_s_per_call"] = (
+                row["device_s"] / row["calls"] if row["calls"] > 0 else None)
+            out[(row["family"], row["variant"])] = r
+        return out
+
+    rows_a, rows_b = _rows(a), _rows(b)
+    keys = list(rows_a)
+    keys.extend(k for k in rows_b if k not in rows_a)
+    out_rows: List[Dict[str, Any]] = []
+    for key in sorted(keys):
+        ra, rb = rows_a.get(key), rows_b.get(key)
+        metrics = {}
+        worst = "same" if (ra and rb) else "n/a"
+        for name, timing in _ATTRIB_METRICS:
+            cell = _verdict(
+                ra.get(name) if ra else None,
+                rb.get(name) if rb else None,
+                rel_tol if timing else 1e-9,
+                abs_floor_s if timing else 0.0,
+            )
+            metrics[name] = cell
+            if cell["verdict"] == "regressed":
+                worst = "regressed"
+            elif cell["verdict"] == "improved" and worst == "same":
+                worst = "improved"
+        out_rows.append({
+            "family": key[0],
+            "variant": key[1],
+            "metrics": metrics,
+            "verdict": worst,
+        })
+    return {
+        "schema": f"{ATTRIB_SCHEMA}-diff",
+        "rel_tol": rel_tol,
+        "abs_floor_s": abs_floor_s,
+        "programs": out_rows,
+        "regressions": sum(
+            1 for r in out_rows if r["verdict"] == "regressed"),
+    }
+
+
+def diff_bench_reports(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    rel_tol: float = 0.05,
+) -> Dict[str, Any]:
+    """Diff two bench.py reports on their single parsed metric. A
+    report without a ``parsed`` block (a failed round) contributes a
+    null value — n/a, never a regression."""
+    pa = a.get("parsed") or {}
+    pb = b.get("parsed") or {}
+    name = pa.get("metric") or pb.get("metric") or "?"
+    if pa.get("metric") and pb.get("metric") \
+            and pa["metric"] != pb["metric"]:
+        raise ValueError(
+            f"bench reports measure different metrics: "
+            f"{pa.get('metric')!r} vs {pb.get('metric')!r}")
+    lower = any(h in name for h in _LOWER_BETTER_HINTS)
+    cell = _verdict(pa.get("value"), pb.get("value"), rel_tol, 0.0,
+                    lower_better=lower)
+    row = {
+        "metric": name,
+        "unit": pa.get("unit"),
+        "direction": "lower_better" if lower else "higher_better",
+        **cell,
+    }
+    return {
+        "schema": "mingpt-bench/1-diff",
+        "rel_tol": rel_tol,
+        "metrics": [row],
+        "regressions": int(cell["verdict"] == "regressed"),
+    }
+
+
+def render_diff(diff: Dict[str, Any]) -> str:
+    """render_slo_diff column idiom: one line per compared metric."""
+
+    def _cell(v: Optional[float]) -> str:
+        return "n/a" if v is None else f"{v:.6g}"
+
+    lines = [f"Perf diff ({diff['schema']}): "
+             f"{diff['regressions']} regression(s)"]
+    lines.append(f"  {'program / metric':<34} {'a':>12} {'b':>12} "
+                 f"{'delta':>12}  verdict")
+    if "programs" in diff:
+        for row in diff["programs"]:
+            name = row["family"] + (f":{row['variant']}"
+                                    if row["variant"] else "")
+            lines.append(f"  {name:<34} {'':>12} {'':>12} {'':>12}  "
+                         f"{row['verdict']}")
+            for metric, _ in _ATTRIB_METRICS:
+                m = row["metrics"][metric]
+                lines.append(
+                    f"    {metric:<32} {_cell(m['a']):>12} "
+                    f"{_cell(m['b']):>12} {_cell(m['delta']):>12}  "
+                    f"{m['verdict']}")
+    else:
+        for m in diff["metrics"]:
+            lines.append(
+                f"  {m['metric']:<34} {_cell(m['a']):>12} "
+                f"{_cell(m['b']):>12} {_cell(m['delta']):>12}  "
+                f"{m['verdict']} ({m['direction']})")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("report_a", help="baseline report (.json)")
+    ap.add_argument("report_b", help="candidate report (.json)")
+    ap.add_argument("--rel-tol", type=float, default=0.05,
+                    help="relative noise tolerance on timing metrics "
+                         "(default 0.05 = 5%%)")
+    ap.add_argument("--abs-floor-s", type=float, default=1e-3,
+                    help="absolute floor (seconds) a timing delta must "
+                         "also clear (default 1ms)")
+    ap.add_argument("--json", default=None, metavar="OUT",
+                    help="also write the diff document to OUT")
+    args = ap.parse_args(argv)
+    docs = []
+    for path in (args.report_a, args.report_b):
+        try:
+            with open(path) as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"cannot read report {path}: {e}", file=sys.stderr)
+            return 2
+    try:
+        kinds = [classify(p, d)
+                 for p, d in zip((args.report_a, args.report_b), docs)]
+        if kinds[0] != kinds[1]:
+            raise ValueError(
+                f"cannot diff a {kinds[0]} report against a {kinds[1]} "
+                f"report")
+        if kinds[0] == "attrib":
+            diff = diff_attrib_reports(
+                docs[0], docs[1], rel_tol=args.rel_tol,
+                abs_floor_s=args.abs_floor_s)
+        else:
+            diff = diff_bench_reports(
+                docs[0], docs[1], rel_tol=args.rel_tol)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    print(render_diff(diff))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(diff, f, sort_keys=True, indent=2)
+            f.write("\n")
+    return 1 if diff["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
