@@ -303,11 +303,14 @@ def profile_inner(outdir: str) -> int:
 
 
 def _attach_multichip(record: dict) -> None:
-    """ZeRO dp update-sharding extra (ISSUE 9): per-device param/opt-state
-    bytes and update-phase time, replicated vs ``zero_dp``, measured on a
-    hermetic virtual-CPU dp mesh in a bounded subprocess. Never fatal, and
-    independent of the accelerator probe (the mesh is host-platform by
-    construction), so it also lands on cpu_fallback records."""
+    """ZeRO dp update-sharding extra (ISSUE 9) plus the tensor-parallel
+    sharded-serving block (ISSUE 14): per-device param/opt-state bytes
+    and update-phase time, replicated vs ``zero_dp``, and per-device
+    KV-pool bytes + decode/prefill time at tp=1 vs tp=2 — all measured
+    on hermetic virtual-CPU meshes in one bounded subprocess. Never
+    fatal, and independent of the accelerator probe (the meshes are
+    host-platform by construction), so it also lands on cpu_fallback
+    records."""
     try:
         if os.environ.get("BENCH_MULTICHIP", "1") == "0":
             raise RuntimeError("disabled via BENCH_MULTICHIP=0")
@@ -1229,7 +1232,14 @@ def multichip_inner() -> int:
     phase jitted twice — replicated and ``zero_dp`` — reporting per-device
     param/opt-state bytes and update-phase wall time for both. The bytes
     are layout facts (addressable-shard sums), valid on any backend; the
-    update-phase ms is a CPU-relative comparison of the two programs."""
+    update-phase ms is a CPU-relative comparison of the two programs.
+
+    A second block (ISSUE 14) measures the serving side of the same
+    story: one DecodeEngine at tp=1 vs tp=2 on the forced devices,
+    reporting per-device KV-pool bytes (a layout fact: halved at tp=2
+    when kv_heads divides) and decode-step / prefill wall time (CPU-
+    relative, tp=2 pays virtual-device collective overhead here — the
+    bytes are the claim, the times are the honesty check)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1337,6 +1347,61 @@ def multichip_inner() -> int:
 
     replicated = measure(None)
     sharded = measure(plan)
+
+    # -- tensor-parallel sharded serving (ISSUE 14) --------------------
+    from mingpt_distributed_tpu.serving.engine import DecodeEngine
+
+    scfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=64, vocab_size=128, block_size=32,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+
+    def measure_serving(tp):
+        serve_mesh = (
+            mesh_lib.make_mesh(MeshConfig(tp=tp), devices=jax.devices()[:tp])
+            if tp > 1 else None
+        )
+        eng = DecodeEngine(
+            gpt.init(jax.random.key(0), scfg), scfg, n_slots=4,
+            mesh=serve_mesh,
+        )
+        eng.warmup()
+        key = jax.random.key(1)
+        s = eng.n_slots
+        tokens = np.zeros(s, np.int32)
+        positions = np.full(s, scfg.block_size - 1, np.int32)
+        temps = np.ones(s, np.float32)
+        top_ks = np.zeros(s, np.int32)
+        top_ps = np.ones(s, np.float32)
+        greedy = np.zeros(s, bool)
+        keys = jnp.stack([key] * s)
+        n = 20
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng.decode_step(
+                tokens, positions, temps, top_ks, top_ps, greedy, keys)
+        decode_ms = (time.perf_counter() - t0) / n * 1e3
+        prompt = [1] * eng.prefill_len
+        n = 10
+        t0 = time.perf_counter()
+        for _ in range(n):
+            eng.prefill_chunk_call(
+                0, prompt, 0, 1.0, None, None, False, key)
+        prefill_ms = (time.perf_counter() - t0) / n * 1e3
+        return {
+            "kv_pool_bytes_per_device": zero_lib.per_device_bytes(
+                eng.pool.cache
+            ),
+            "kv_pool_bytes_total": sum(
+                int(a.nbytes) for a in eng.pool.cache.values()
+            ),
+            "decode_step_ms": round(decode_ms, 2),
+            "prefill_ms": round(prefill_ms, 2),
+        }
+
+    serving_tp1 = measure_serving(1)
+    serving_tp2 = measure_serving(2)
+
     print(json.dumps({
         "mesh": {"dp": dp},
         "n_devices": dp,
@@ -1347,6 +1412,14 @@ def multichip_inner() -> int:
             sharded["opt_state_bytes_per_device"]
             / max(replicated["opt_state_bytes_per_device"], 1), 4
         ),
+        "sharded_serving": {
+            "tp1": serving_tp1,
+            "tp2": serving_tp2,
+            "kv_bytes_per_device_ratio": round(
+                serving_tp2["kv_pool_bytes_per_device"]
+                / max(serving_tp1["kv_pool_bytes_per_device"], 1), 4
+            ),
+        },
     }), flush=True)
     return 0
 

@@ -46,6 +46,20 @@ solo ``generate()`` exactly (tests/test_serving.py asserts token identity).
 Chunked prefill is exactly row-equivalent to one whole-prompt forward:
 attention, MLP and norms are row-wise, and a chunk's queries see the same
 keys at the same absolute positions the one-shot forward would.
+
+Tensor-parallel sharding (ISSUE 14): the engine optionally runs across a
+``jax.sharding.Mesh``. Params shard by ``parallel/mesh.py``'s megatron
+rules (column/row-split matmuls over the tp axis); the KV pool and every
+prefix-store entry shard their *heads* dimension over the same axis, so
+per-device KV bytes are ``total / tp`` and attention — embarrassingly
+parallel over heads — never moves K/V between chips. The sharding is
+bound into each program as a partial-bound constant (``kv_sharding``
+below), making the mesh part of the program's compile identity the same
+way ``cfg`` is: one engine = one mesh = still exactly one executable per
+family, so ``compile_counts()`` and the recompile watchdog are oblivious
+to sharding. ``_pin_kv`` re-asserts the sharding on every program's cache
+output, which keeps donation aliasing exact (output layout == input
+layout) and stops GSPMD from ever deciding to gather the pool.
 """
 
 from __future__ import annotations
@@ -59,11 +73,42 @@ import numpy as np
 
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.models import generate as gen
+from mingpt_distributed_tpu.parallel import mesh as mesh_lib
 from mingpt_distributed_tpu.serving.kv_pool import PrefixKVStore, SlotKVPool
 
 #: smallest default bucket — prompts below this pay one 64-token forward,
 #: which already beats a block_size² prefill by >100x at block_size 1024
 DEFAULT_MIN_BUCKET = 64
+
+
+def kv_pool_spec(tp_axis: str = "tp"):
+    """PartitionSpec of the (L, S, block, KV, hd) pool cache — and of the
+    (L, 1, P, KV, hd) prefix entries it exchanges rows with: KV heads
+    shard over the tensor axis, every other dimension replicates. Heads
+    are the right axis because attention is independent per head, so a
+    head-sharded cache is read and written only by the chip that owns it
+    (no collective touches K/V); slots must stay whole per device (the
+    traced-slot dynamic slices address the full slot axis). head_dim is
+    deliberately NOT spelled as a trailing None: the runtime normalizes
+    compiled-output specs by stripping trailing Nones, and executable
+    cache keys compare shardings by equality — an unnormalized spec on
+    the warmup cache would make the first serving call on a warmed
+    bucket compile a second (identical) executable."""
+    return jax.sharding.PartitionSpec(None, None, None, tp_axis)
+
+
+def _pin_kv(cache, kv_sharding):
+    """``with_sharding_constraint`` over a ``{"k","v"}`` cache (or prefix
+    entry) pytree. ``kv_sharding`` reaches every program as a
+    partial-bound constant — trace-time static, exactly like ``cfg`` —
+    which is how the mesh participates in the compile key without adding
+    executables. ``None`` (single-device engine) is the identity."""
+    if kv_sharding is None:
+        return cache
+    return {
+        name: jax.lax.with_sharding_constraint(cache[name], kv_sharding)
+        for name in ("k", "v")
+    }
 
 
 def bucket_ladder(
@@ -151,7 +196,7 @@ def _install_lane(cache, lane, slot):
 def _prefill_impl(
     params, cache, chunk, length, offset, slot,
     temp, top_k, top_p, do_sample, key,
-    *, cfg: GPTConfig,
+    *, cfg: GPTConfig, kv_sharding=None,
 ):
     """chunk: (bucket,) right-padded tokens; length/offset/slot traced
     scalars. Forwards the chunk at absolute position ``offset`` against
@@ -167,12 +212,12 @@ def _prefill_impl(
         logits, key[None], temp[None], top_k[None], top_p[None],
         do_sample[None],
     )[0]
-    return tok, _install_lane(cache, lane, slot)
+    return tok, _pin_kv(_install_lane(cache, lane, slot), kv_sharding)
 
 
 def _decode_impl(
     params, cache, tokens, positions, temps, top_ks, top_ps, do_sample, keys,
-    *, cfg: GPTConfig,
+    *, cfg: GPTConfig, kv_sharding=None,
 ):
     """One token for every slot: tokens/positions (S,), sampling arrays
     (S,), keys (S,). Returns (next tokens (S,), updated pool cache)."""
@@ -189,30 +234,34 @@ def _decode_impl(
     logits, cache = jax.vmap(one_slot, in_axes=(0, 1, 0), out_axes=(0, 1))(
         tokens, cache, safe_pos)
     nxt = _select_next_slots(logits, keys, temps, top_ks, top_ps, do_sample)
-    return nxt, cache
+    return nxt, _pin_kv(cache, kv_sharding)
 
 
-def _extract_prefix_impl(cache, slot, *, rows: int):
+def _extract_prefix_impl(cache, slot, *, rows: int, kv_sharding=None):
     """Copy the first ``rows`` K/V rows of a slot lane out of the pool —
     the device-side read half of a prefix-store insert. ``rows`` is static
-    (one trace per bucket-quantized prefix length)."""
+    (one trace per bucket-quantized prefix length). The entry keeps the
+    pool's head-sharding (same spec, smaller row count), so storing a
+    prefix never gathers K/V to one chip."""
     l, _, _, kv, hd = cache["k"].shape
-    return {
+    return _pin_kv({
         name: jax.lax.dynamic_slice(
             cache[name], (0, slot, 0, 0, 0), (l, 1, rows, kv, hd))
         for name in ("k", "v")
-    }
+    }, kv_sharding)
 
 
-def _install_prefix_impl(cache, entry_k, entry_v, slot):
+def _install_prefix_impl(cache, entry_k, entry_v, slot, *, kv_sharding=None):
     """Write a stored (L, 1, P, KV, hd) prefix entry into rows [0, P) of a
-    slot lane — a device-side dynamic_update_slice, no recompute."""
-    return {
+    slot lane — a device-side dynamic_update_slice, no recompute. Entry
+    and pool carry the same head-sharding, so a hit is a chip-local row
+    copy."""
+    return _pin_kv({
         "k": jax.lax.dynamic_update_slice(
             cache["k"], entry_k.astype(cache["k"].dtype), (0, slot, 0, 0, 0)),
         "v": jax.lax.dynamic_update_slice(
             cache["v"], entry_v.astype(cache["v"].dtype), (0, slot, 0, 0, 0)),
-    }
+    }, kv_sharding)
 
 
 class DecodeEngine:
@@ -235,8 +284,25 @@ class DecodeEngine:
         prefill_buckets: Optional[Sequence[int]] = None,
         prefill_chunk: Optional[int] = None,
         prefix_cache_mb: float = 0.0,
+        mesh: Optional[jax.sharding.Mesh] = None,
+        tp_axis: str = "tp",
     ):
         self.cfg = cfg
+        self.mesh = mesh
+        self.tp_axis = tp_axis
+        if mesh is not None:
+            # One placement decision, made once: params follow the megatron
+            # column/row rules, the pool shards heads over the tp axis (or
+            # downgrades to replication when kv_heads % tp != 0 — counted
+            # by shard_by_rule's telemetry, never an error).
+            params = jax.device_put(
+                params, mesh_lib.param_shardings(mesh, params))
+            cache_shape = (cfg.n_layer, n_slots, cfg.block_size,
+                           cfg.kv_heads, cfg.head_dim)
+            self.kv_sharding = mesh_lib.shard_by_rule(
+                mesh, cache_shape, kv_pool_spec(tp_axis), name="kv_cache")
+        else:
+            self.kv_sharding = None
         self.params = params
         self.prefill_len = int(prefill_len or cfg.block_size)
         if not (1 <= self.prefill_len <= cfg.block_size):
@@ -254,24 +320,44 @@ class DecodeEngine:
         self.prefill_chunk = prefill_chunk
         self.buckets = bucket_ladder(
             self.prefill_len, prefill_buckets, prefill_chunk)
-        self.pool = SlotKVPool(cfg, n_slots, cache_dtype)
+        self.pool = SlotKVPool(
+            cfg, n_slots, cache_dtype, sharding=self.kv_sharding)
+        # the pool normalizes the sharding to the runtime's canonical
+        # form; the programs must bind THAT object, or executable keys
+        # (which compare shardings) would treat warmup inputs and
+        # compiled-output caches as different layouts
+        self.kv_sharding = self.pool.sharding
         self.prefix_store = (
             PrefixKVStore(int(prefix_cache_mb * (1 << 20)))
             if prefix_cache_mb > 0 else None
         )
+        # kv_sharding rides as a partial-bound constant beside cfg: the
+        # mesh is compile identity, not a traced input, so each family
+        # still owns exactly one jit wrapper (and one executable).
+        kv = self.kv_sharding
         self._prefill_jit = jax.jit(
-            functools.partial(_prefill_impl, cfg=cfg), donate_argnums=(1,))
+            functools.partial(_prefill_impl, cfg=cfg, kv_sharding=kv),
+            donate_argnums=(1,))
         self._decode_jit = jax.jit(
-            functools.partial(_decode_impl, cfg=cfg), donate_argnums=(1,))
+            functools.partial(_decode_impl, cfg=cfg, kv_sharding=kv),
+            donate_argnums=(1,))
         # prefix copy programs: `rows` is static, so one jit wrapper traces
         # once per bucket-quantized prefix length
         self._extract_jit = jax.jit(
-            _extract_prefix_impl, static_argnames=("rows",))
-        self._install_jit = jax.jit(_install_prefix_impl, donate_argnums=(0,))
+            functools.partial(_extract_prefix_impl, kv_sharding=kv),
+            static_argnames=("rows",))
+        self._install_jit = jax.jit(
+            functools.partial(_install_prefix_impl, kv_sharding=kv),
+            donate_argnums=(0,))
 
     @property
     def n_slots(self) -> int:
         return self.pool.n_slots
+
+    @property
+    def kv_shard_count(self) -> int:
+        """Devices one pool buffer is split over (1 = unsharded)."""
+        return self.pool.shard_count
 
     @property
     def chunk_size(self) -> int:

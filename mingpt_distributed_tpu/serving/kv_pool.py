@@ -15,6 +15,16 @@ Allocation is deterministic (lowest free index first) so a given arrival
 order always produces the same slot placement — the scheduler tests rely
 on replayability.
 
+Tensor-parallel serving (ISSUE 14): the pool optionally carries a
+``NamedSharding`` that splits the KV-heads axis over the mesh's tp axis,
+so each device holds ``total / tp`` cache bytes. The sharding is decided
+once at construction (it is part of the engine's program identity, see
+serving/engine.py) and never changes — the buffers keep the same global
+shape, owner-visible identity and host-side free-list semantics whether
+they live on one chip or many. Ownership (which slot belongs to which
+request) stays a host concept; placement (which chip holds which heads)
+is the sharding's concern — the two never interact.
+
 ``PrefixKVStore`` is the byte-bounded LRU behind shared-prefix reuse
 (the system-prompt case): entries are device-resident ``(L, 1, P, KV,
 hd)`` K/V row blocks keyed by the exact token tuple they encode, with P
@@ -25,6 +35,7 @@ copies its rows instead of recomputing them and prefills only the tail.
 
 from __future__ import annotations
 
+import math
 from collections import OrderedDict
 from typing import List, Optional, Tuple
 
@@ -40,13 +51,38 @@ class SlotKVPool:
     in place at the buffer level while this object keeps a stable handle.
     """
 
-    def __init__(self, cfg: GPTConfig, n_slots: int, dtype=None):
+    def __init__(self, cfg: GPTConfig, n_slots: int, dtype=None,
+                 sharding=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.cfg = cfg
         self.n_slots = n_slots
-        self.cache: Cache = init_cache(cfg, n_slots, dtype)
+        cache: Cache = init_cache(cfg, n_slots, dtype)
+        if sharding is not None:
+            import jax
+
+            cache = jax.device_put(
+                cache, {"k": sharding, "v": sharding})
+            # adopt the runtime's normalized sharding (trailing-None
+            # PartitionSpec entries stripped): compiled-program outputs
+            # carry the normalized form, and the engine keys executables
+            # on sharding equality — an unnormalized spec here would make
+            # the first serving call on a warmed bucket look novel
+            sharding = cache["k"].sharding
+        self.sharding = sharding
+        self.cache = cache
         self._free: List[int] = list(range(n_slots))  # kept sorted
+
+    @property
+    def shard_count(self) -> int:
+        """How many devices one cache buffer is physically split over
+        (1 = single-device or replicated — e.g. a kv_heads count the tp
+        extent doesn't divide, which shard_by_rule downgrades)."""
+        if self.sharding is None:
+            return 1
+        shape = tuple(self.cache["k"].shape)
+        shard = self.sharding.shard_shape(shape)
+        return math.prod(shape) // math.prod(shard)
 
     @property
     def free_count(self) -> int:
@@ -98,6 +134,12 @@ class PrefixKVStore:
 
     def contains(self, key: Tuple[int, ...]) -> bool:
         return key in self._entries
+
+    def entries(self):
+        """(key, (k, v)) pairs in LRU order — read-only introspection for
+        accounting and the sharded-serving selftest (which asserts stored
+        entries keep the pool's head-sharding instead of gathering)."""
+        return list(self._entries.items())
 
     @staticmethod
     def _nbytes(kv) -> int:

@@ -104,6 +104,7 @@ from mingpt_distributed_tpu.telemetry import (
     SpanTracer,
     build_attrib_report,
     log_event,
+    per_device_tree_bytes,
     tree_bytes,
 )
 from mingpt_distributed_tpu.telemetry.tracing import (
@@ -215,12 +216,19 @@ class InferenceServer:
         spec_k: int = 0,
         admission_policy: Optional[AdmissionPolicy] = None,
         attrib: bool = False,
+        mesh=None,
+        tp_axis: str = "tp",
     ):
         self.cfg = cfg
+        # mesh passes through untouched: the scheduler owns slots
+        # (ownership), the engine's sharding owns placement — the two
+        # never interact, so every scheduling decision below is
+        # mesh-oblivious.
         self.engine = DecodeEngine(
             params, cfg, n_slots, prefill_len,
             prefill_buckets=prefill_buckets, prefill_chunk=prefill_chunk,
             prefix_cache_mb=prefix_cache_mb,
+            mesh=mesh, tp_axis=tp_axis,
         )
         # speculative decoding (serving/speculative.py): a draft model +
         # spec_k >= 1 turn the decode round into propose→verify→accept-n.
@@ -312,18 +320,33 @@ class InferenceServer:
         slot pool, the prefix store's current residency, and (with
         speculation on) the draft model's params and mirrored pool.
         Re-run before each report so LRU churn in the prefix store is
-        reflected."""
+        reflected. Each owner also carries its busiest-device residency
+        (per_device_bytes): total/tp for tp-sharded owners, == total on a
+        single device — the per-chip number that actually bounds slots on
+        a mesh (ISSUE 14)."""
         if self.hbm is None:
             return
-        self.hbm.account("params", tree_bytes(self.engine.params))
-        self.hbm.account("kv_pool", tree_bytes(self.engine.pool.cache))
-        store = self.engine.prefix_store
-        self.hbm.account("prefix_store",
-                         0 if store is None else store.used_bytes)
+        eng = self.engine
+        self.hbm.account("params", tree_bytes(eng.params),
+                         per_device_bytes=per_device_tree_bytes(eng.params))
+        self.hbm.account("kv_pool", tree_bytes(eng.pool.cache),
+                         per_device_bytes=per_device_tree_bytes(
+                             eng.pool.cache))
+        store = eng.prefix_store
+        store_bytes = 0 if store is None else store.used_bytes
+        # prefix entries carry the pool's head-sharding, so per-device
+        # residency divides by the pool's shard count (analytic — entries
+        # are many small arrays, summing shard shapes per entry says the
+        # same thing slower)
+        self.hbm.account("prefix_store", store_bytes,
+                         per_device_bytes=store_bytes // eng.kv_shard_count)
         if self.spec is not None:
             de = self.spec.draft.engine
-            self.hbm.account("draft_params", tree_bytes(de.params))
-            self.hbm.account("draft_pool", tree_bytes(de.pool.cache))
+            self.hbm.account("draft_params", tree_bytes(de.params),
+                             per_device_bytes=per_device_tree_bytes(de.params))
+            self.hbm.account("draft_pool", tree_bytes(de.pool.cache),
+                             per_device_bytes=per_device_tree_bytes(
+                                 de.pool.cache))
 
     def attrib_report(self, include_live: bool = False) -> Dict[str, Any]:
         """The mingpt-attrib/1 report for this server (raises when the

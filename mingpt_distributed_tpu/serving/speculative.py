@@ -49,6 +49,7 @@ from ..models import generate as gen
 from .engine import (
     DecodeEngine,
     _install_lane,
+    _pin_kv,
     _select_next_slots,
     _slot_lane,
 )
@@ -58,7 +59,7 @@ __all__ = ["DraftEngine", "SpeculativeDecoder"]
 
 def _verify_impl(
     params, cache, tokens, offset, slot, temp, top_k, top_p, key,
-    *, cfg: GPTConfig,
+    *, cfg: GPTConfig, kv_sharding=None,
 ):
     """Score ``tokens`` (rows = k+1, static) at absolute positions
     ``offset..offset+rows-1`` against one slot lane and return the
@@ -78,7 +79,7 @@ def _verify_impl(
         jnp.full((rows,), top_p, jnp.float32),
         jnp.zeros((rows,), bool),
     )
-    return nxt, _install_lane(cache, lane, slot)
+    return nxt, _pin_kv(_install_lane(cache, lane, slot), kv_sharding)
 
 
 class DraftEngine:
@@ -110,6 +111,8 @@ class DraftEngine:
             params, cfg, target.n_slots,
             prefill_len=target.prefill_len,
             prefill_buckets=target.buckets,
+            mesh=target.mesh,
+            tp_axis=target.tp_axis,
         )
 
     def bind(self, slot: int) -> None:
@@ -159,7 +162,8 @@ class SpeculativeDecoder:
         self.draft = DraftEngine(draft_params, draft_cfg, target)
         self._parked = target.cfg.block_size - 1
         self._verify_jit = jax.jit(
-            functools.partial(_verify_impl, cfg=target.cfg),
+            functools.partial(_verify_impl, cfg=target.cfg,
+                              kv_sharding=target.kv_sharding),
             donate_argnums=(1,))
 
     # -- slot lifecycle (mirrors the target pool) ----------------------

@@ -44,6 +44,7 @@ from mingpt_distributed_tpu.telemetry.attribution import (
     build_attrib_report,
     dump_attrib_report,
     kv_cache_bytes,
+    per_device_tree_bytes,
     render_attrib_report,
     timed_aot_compile,
     tree_bytes,
@@ -163,6 +164,7 @@ __all__ = [
     "timed_aot_compile",
     "trace_baggage",
     "trace_sink",
+    "per_device_tree_bytes",
     "tree_bytes",
     "validate_attrib_report",
     "validate_trace_records",

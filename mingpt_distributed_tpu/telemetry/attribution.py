@@ -58,6 +58,7 @@ __all__ = [
     "build_attrib_report",
     "dump_attrib_report",
     "kv_cache_bytes",
+    "per_device_tree_bytes",
     "render_attrib_report",
     "timed_aot_compile",
     "tree_bytes",
@@ -298,6 +299,29 @@ def tree_bytes(tree: Any) -> int:
     return int(total)
 
 
+def per_device_tree_bytes(tree: Any) -> int:
+    """Analytic bytes of a pytree *on one device* — each leaf contributes
+    its shard size (``sharding.shard_shape``), so a head-sharded KV pool
+    counts ``total / tp`` and a replicated or single-device leaf counts
+    its full size (ISSUE 14). Analytic like ``tree_bytes`` (no
+    ``addressable_shards`` readout): exact for donated buffers and
+    byte-deterministic across runs. Leaves without a sharding (numpy
+    arrays, ShapeDtypeStructs) fall back to full size."""
+    import jax  # lazy: telemetry must import without a backend
+
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            shape = sharding.shard_shape(tuple(shape))
+        total += int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    return int(total)
+
+
 def kv_cache_bytes(cfg: Any, n_slots: int, dtype: Any = None) -> int:
     """Exact bytes of one slot-pool KV cache: the two
     ``(n_layer, n_slots, block_size, kv_heads, head_dim)`` buffers of
@@ -327,10 +351,16 @@ class HBMLedger:
         self.capacity_bytes = (capacity_bytes if capacity_bytes is not None
                                else peak_hbm_capacity_per_chip())
         self._owners: Dict[str, int] = {}
+        self._per_device: Dict[str, int] = {}
         r = self.registry
         self._g_owner = r.gauge(
             "mingpt_attrib_hbm_bytes",
             help="accounted HBM bytes by owner (shapes/dtypes, exact)",
+            labels=("owner",))
+        self._g_owner_pd = r.gauge(
+            "mingpt_attrib_hbm_per_device_bytes",
+            help="accounted HBM bytes by owner on the busiest device "
+                 "(total/tp for tp-sharded owners, == total unsharded)",
             labels=("owner",))
         self._g_total = r.gauge(
             "mingpt_attrib_hbm_total_bytes",
@@ -346,11 +376,24 @@ class HBMLedger:
             help="chip HBM capacity minus accounted bytes "
                  "(absent off-TPU: no capacity table row)")
 
-    def account(self, owner: str, nbytes: int) -> None:
+    def account(self, owner: str, nbytes: int,
+                per_device_bytes: Optional[int] = None) -> None:
+        """Declare an owner's bytes. ``per_device_bytes`` is the owner's
+        residency on one device (ISSUE 14: total/tp when the owner is
+        tp-sharded); omitted it defaults to ``nbytes`` — the single-device
+        truth — so existing callers stay exact."""
         if nbytes < 0:
             raise ValueError(f"owner {owner!r}: negative bytes {nbytes}")
+        if per_device_bytes is None:
+            per_device_bytes = nbytes
+        if not (0 <= per_device_bytes <= nbytes):
+            raise ValueError(
+                f"owner {owner!r}: per_device_bytes {per_device_bytes} "
+                f"outside [0, {nbytes}]")
         self._owners[owner] = int(nbytes)
+        self._per_device[owner] = int(per_device_bytes)
         self._g_owner.labels(owner=owner).set(int(nbytes))
+        self._g_owner_pd.labels(owner=owner).set(int(per_device_bytes))
         total = self.total_bytes()
         self._g_total.set(total)
         if self.capacity_bytes is not None:
@@ -358,6 +401,10 @@ class HBMLedger:
 
     def owners(self) -> Dict[str, int]:
         return dict(sorted(self._owners.items()))
+
+    def per_device(self) -> Dict[str, int]:
+        """Per-owner busiest-device bytes, same keys as ``owners()``."""
+        return dict(sorted(self._per_device.items()))
 
     def total_bytes(self) -> int:
         return sum(self._owners.values())
@@ -410,6 +457,7 @@ def build_attrib_report(
         total = hbm.total_bytes()
         block: Dict[str, Any] = {
             "owners": owners,
+            "per_device_bytes": hbm.per_device(),
             "total_bytes": total,
             "capacity_bytes": hbm.capacity_bytes,
             "headroom_bytes": (None if hbm.capacity_bytes is None
@@ -479,6 +527,20 @@ def validate_attrib_report(report: Dict[str, Any]) -> None:
             raise ValueError(
                 f"hbm.total_bytes={hbm.get('total_bytes')!r} != sum of "
                 f"owners {sum(owners.values())}")
+        pd = hbm.get("per_device_bytes")
+        if pd is not None:
+            if not isinstance(pd, dict):
+                raise ValueError("hbm.per_device_bytes must be an object")
+            if set(pd) != set(owners):
+                raise ValueError(
+                    f"hbm.per_device_bytes keys {sorted(pd)} != owners "
+                    f"{sorted(owners)}")
+            for owner, nb in pd.items():
+                if not isinstance(nb, int) or isinstance(nb, bool) \
+                        or not (0 <= nb <= owners[owner]):
+                    raise ValueError(
+                        f"hbm.per_device_bytes[{owner!r}]={nb!r} is not an "
+                        f"integer in [0, {owners[owner]}]")
     peaks = report.get("peaks")
     if not isinstance(peaks, dict):
         raise ValueError("peaks must be an object")
@@ -513,6 +575,10 @@ def render_attrib_report(report: Dict[str, Any]) -> str:
         lines.append(f"  HBM: total {hbm['total_bytes']} bytes"
                      + ("" if hbm.get("headroom_bytes") is None else
                         f", headroom {hbm['headroom_bytes']:.3g}"))
+        pd = hbm.get("per_device_bytes") or {}
         for owner, nb in hbm["owners"].items():
-            lines.append(f"    {owner:<20} {nb:>14}")
+            per_dev = pd.get(owner)
+            suffix = ("" if per_dev is None or per_dev == nb
+                      else f"  ({per_dev} / device)")
+            lines.append(f"    {owner:<20} {nb:>14}{suffix}")
     return "\n".join(lines)

@@ -143,13 +143,31 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 # deterministic clock must dump byte-identical reports with
 # tools/perf_diff.py finding zero regressions between them; /attrib and
 # the fleet-merged /metrics page (per-replica mingpt_attrib_* samples
-# under the replica label) must scrape strict-valid.
+# under the replica label) must scrape strict-valid. Runs on 2 forced
+# host devices (ISSUE 14) so the per-device accounting sub-check also
+# exercises a tp=2-sharded pool against jax.live_arrays() per device.
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
     JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest-attrib --prefill-chunk 8 \
         --prefill-buckets 8,16,32 --prefix-cache-mb 0.5 --warmup \
         --attrib-json "$OBS_DIR/attrib.json"
+
+# Tensor-parallel sharded-serving gate (ISSUE 14): on 2 forced host
+# devices, a tp=2 server (params by megatron rules, KV pool + prefix
+# entries head-sharded over the mesh) must be greedy token-identical to
+# the tp=1 server on the same weights — across chunked prefill, the
+# bucket ladder and prefix-store hits — with IDENTICAL compile_counts()
+# (the mesh rides the compile key, never adds executables), zero
+# post-warmup recompiles, head-sharded stored prefix entries, and
+# per-device pool bytes = total/2 in the strict-validated attrib report.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-sharded --prefill-chunk 6 \
+        --prefill-buckets 4,6,8,16,32,48 --prefix-cache-mb 4 --warmup
 
 # The attribution artifacts round-trip through the offline tools:
 # trace_summary renders the per-family flops/bytes/compile table from

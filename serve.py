@@ -153,6 +153,21 @@ def build_argparser() -> argparse.ArgumentParser:
                         "(identical-draft and truncated-draft variants, "
                         "incl. chunked prefill + prefix reuse) with O(1) "
                         "verify executables; exits non-zero on mismatch")
+    p.add_argument("--mesh", default=None, metavar="SPEC",
+                   help="run the engine across a device mesh (ISSUE 14): "
+                        "'axis=N' clauses joined by ',', e.g. 'tp=2' "
+                        "shards params (megatron rules) and the KV pool's "
+                        "heads over 2 devices so per-device KV bytes are "
+                        "total/2; testable off-TPU via XLA_FLAGS="
+                        "--xla_force_host_platform_device_count=N")
+    p.add_argument("--selftest-sharded", action="store_true",
+                   help="ISSUE 14 gate (run under forced host devices): "
+                        "tp=2 server must be greedy token-identical to "
+                        "tp=1 (incl. chunked prefill, prefix hits and "
+                        "speculation), with identical compile_counts(), "
+                        "zero watchdog recompiles, head-sharded prefix "
+                        "entries and per-device pool bytes = total/2 in "
+                        "the attrib report; then exits")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve Prometheus /metrics + /healthz on this port "
                         "(0 = ephemeral port, printed at start); default: "
@@ -264,6 +279,46 @@ def _server_kwargs(args) -> dict:
         prefix_cache_mb=args.prefix_cache_mb,
         warmup=args.warmup,
     )
+
+
+def _mesh_kwargs(args) -> dict:
+    """Resolve --mesh 'axis=N,...' into InferenceServer mesh kwargs
+    (empty dict = single-device serving, byte-identical to before the
+    flag existed). Builds the named mesh over the first prod(N) local
+    devices — serving shards one model replica, it does not claim the
+    whole host's device set the way training does."""
+    if args.mesh is None:
+        return {}
+    import math
+
+    from mingpt_distributed_tpu.parallel.mesh import (
+        AXES,
+        MeshConfig,
+        make_mesh,
+    )
+
+    overrides = {}
+    for clause in str(args.mesh).split(","):
+        k, sep, v = clause.partition("=")
+        k = k.strip()
+        if not sep or k not in AXES:
+            raise SystemExit(f"--mesh clause {clause!r} is not axis=N "
+                             f"(axes: {', '.join(AXES)})")
+        try:
+            overrides[k] = int(v)
+        except ValueError:
+            raise SystemExit(f"--mesh {k}={v!r}: extent must be an int")
+    import jax
+
+    need = math.prod(overrides.values())
+    have = len(jax.devices())
+    if need > have:
+        raise SystemExit(
+            f"--mesh {args.mesh!r} needs {need} devices, have {have} "
+            f"(off-TPU: XLA_FLAGS=--xla_force_host_platform_device_count"
+            f"={need})")
+    mesh = make_mesh(MeshConfig(**overrides), devices=jax.devices()[:need])
+    return dict(mesh=mesh)
 
 
 def _draft_from(spec, params, cfg):
@@ -1128,6 +1183,46 @@ def selftest_attrib(args) -> int:
     if owners.get("params", 0) <= 0:
         print("selftest-attrib FAIL: params not accounted in hbm ledger")
         rc = 1
+    # per-device accounting (ISSUE 14): unsharded owners report their
+    # full bytes per device; with >= 2 devices a tp=2 server's ledger
+    # must match what the runtime actually holds per device
+    # (jax.live_arrays(), bucketed by shard device)
+    per_dev = report_a["hbm"]["per_device_bytes"]
+    for owner in pools:
+        if per_dev.get(owner) != owners.get(owner):
+            print(f"selftest-attrib FAIL: unsharded owner {owner} "
+                  f"per-device {per_dev.get(owner)} != total "
+                  f"{owners.get(owner)}")
+            rc = 1
+    if len(jax.devices()) >= 2:
+        from mingpt_distributed_tpu.parallel.mesh import (
+            MeshConfig,
+            make_mesh,
+        )
+
+        mesh = make_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+        srv_sh = InferenceServer(params, cfg, n_slots=2, attrib=True,
+                                 mesh=mesh, **_server_kwargs(args))
+        sh_report = srv_sh.attrib_report()
+        sh_owner = sh_report["hbm"]["owners"]["kv_pool"]
+        sh_pd = sh_report["hbm"]["per_device_bytes"]["kv_pool"]
+        if sh_pd * 2 != sh_owner:
+            print(f"selftest-attrib FAIL: sharded kv_pool per-device "
+                  f"{sh_pd} != total {sh_owner} / 2")
+            rc = 1
+        pool_ids = {id(a) for a in jax.tree.leaves(srv_sh.engine.pool.cache)}
+        live_per_dev = {}
+        for arr in jax.live_arrays():
+            if id(arr) in pool_ids:
+                for shard in arr.addressable_shards:
+                    live_per_dev[shard.device] = (
+                        live_per_dev.get(shard.device, 0)
+                        + int(shard.data.nbytes))
+        if sorted(live_per_dev.values()) != [sh_pd, sh_pd]:
+            print(f"selftest-attrib FAIL: ledger says {sh_pd} pool bytes "
+                  f"per device but live_arrays holds "
+                  f"{sorted(live_per_dev.values())}")
+            rc = 1
     audit = srv_a.hbm.audit()
     if audit["live_bytes"] < owners.get("kv_pool", 0):
         print(f"selftest-attrib FAIL: live_arrays audit below the pool "
@@ -1298,8 +1393,131 @@ def _attrib_scrape_fleet(tserver) -> int:
     return rc
 
 
+def selftest_sharded(args) -> int:
+    """The ISSUE 14 acceptance gate, CPU-only via forced host devices.
+
+    Two servers over identical random-init weights and canned prompts —
+    one single-device, one tp=2 across a mesh — must produce identical
+    greedy tokens (placement is invisible to sampling: attention is
+    head-parallel and the megatron param split reassembles exactly),
+    with identical ``compile_counts()`` (the mesh rides the compile key,
+    it never adds executables), zero post-warmup recompiles, prefix-hit
+    parity, head-sharded prefix entries, and ``per_device_bytes = total
+    / 2`` for the sharded pools in the attribution report."""
+    import jax
+
+    from mingpt_distributed_tpu import telemetry
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.parallel.mesh import MeshConfig, make_mesh
+    from mingpt_distributed_tpu.serving import InferenceServer, Request
+
+    if len(jax.devices()) < 2:
+        print("selftest-sharded FAIL: needs >= 2 devices (run under "
+              "XLA_FLAGS=--xla_force_host_platform_device_count=2)")
+        return 1
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's"]
+    if args.prefix_cache_mb > 0:
+        canned += ["Once more unto the breach", "Once more unto the wall!"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 10
+
+    def run_once(mesh):
+        srv = InferenceServer(params, cfg, n_slots=2, attrib=True,
+                              mesh=mesh, **_server_kwargs(args))
+        handles = srv.generate_batch(
+            [Request(prompt=p, max_new_tokens=max_new) for p in prompts])
+        return srv, [h.tokens for h in handles]
+
+    rc = 0
+    srv1, toks1 = run_once(None)
+    mesh = make_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    srv2, toks2 = run_once(mesh)
+
+    for text, a, b in zip(canned, toks1, toks2):
+        ok = a == b
+        print(f"selftest-sharded ({text!r}): "
+              + ("OK" if ok else f"MISMATCH tp1={a} tp2={b}"))
+        if not ok:
+            rc = 1
+
+    c1, c2 = srv1.compile_counts(), srv2.compile_counts()
+    if c1 != c2:
+        print(f"selftest-sharded FAIL: compile_counts diverge under "
+              f"sharding: tp1={c1} tp2={c2}")
+        rc = 1
+    ladder = len(srv2.engine.buckets)
+    if c2["decode"] != 1 or c2["prefill"] > ladder:
+        print(f"selftest-sharded FAIL: unbounded compilation: {c2} "
+              f"(ladder size {ladder})")
+        rc = 1
+    for name, srv in (("tp1", srv1), ("tp2", srv2)):
+        if srv.watchdog.recompiles:
+            print(f"selftest-sharded FAIL: {name} watchdog counted "
+                  f"{srv.watchdog.recompiles} post-warmup recompile(s)")
+            rc = 1
+    if args.warmup and not srv2.watchdog.armed:
+        print("selftest-sharded FAIL: --warmup set but watchdog not armed")
+        rc = 1
+
+    if srv2.engine.kv_shard_count != 2:
+        print(f"selftest-sharded FAIL: tp=2 pool is split over "
+              f"{srv2.engine.kv_shard_count} device(s), expected 2")
+        rc = 1
+    if args.prefix_cache_mb > 0:
+        for name, srv in (("tp1", srv1), ("tp2", srv2)):
+            if srv.metrics.prefix_hits < 1:
+                print(f"selftest-sharded FAIL: no prefix hit on {name}")
+                rc = 1
+        # stored entries must carry the pool's head-sharding — a prefix
+        # hit is a chip-local row copy, never a gather
+        for key, (ek, ev) in srv2.engine.prefix_store.entries():
+            for arr in (ek, ev):
+                shard = arr.sharding.shard_shape(arr.shape)
+                if shard[3] * 2 != arr.shape[3]:
+                    print(f"selftest-sharded FAIL: prefix entry "
+                          f"(rows={len(key)}) not head-sharded: "
+                          f"{arr.shape} -> {shard}")
+                    rc = 1
+
+    # attribution: the sharded pools' per-device residency is total/2,
+    # and the report still strict-validates with the new block
+    report = srv2.attrib_report()
+    try:
+        telemetry.validate_attrib_report(report)
+    except ValueError as e:
+        print(f"selftest-sharded FAIL: attrib report invalid: {e}")
+        return 1
+    owners = report["hbm"]["owners"]
+    per_dev = report["hbm"]["per_device_bytes"]
+    for owner in ("kv_pool",):
+        if per_dev.get(owner, -1) * 2 != owners.get(owner, 0):
+            print(f"selftest-sharded FAIL: {owner} per-device bytes "
+                  f"{per_dev.get(owner)} != total {owners.get(owner)} / 2")
+            rc = 1
+    base_owners = srv1.attrib_report()["hbm"]["owners"]
+    if owners.get("kv_pool") != base_owners.get("kv_pool"):
+        print(f"selftest-sharded FAIL: sharding changed the pool's total "
+              f"bytes: tp1={base_owners.get('kv_pool')} "
+              f"tp2={owners.get('kv_pool')}")
+        rc = 1
+
+    print(f"selftest-sharded compile_counts: {c2}")
+    print(f"selftest-sharded hbm: total={owners} per_device={per_dev}")
+    print("selftest-sharded", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    if args.selftest_sharded:
+        return selftest_sharded(args)
     if args.selftest_attrib:
         return selftest_attrib(args)
     if args.selftest_chaos:
@@ -1356,6 +1574,7 @@ def main(argv=None) -> int:
     reg, tserver = _start_telemetry(args)
     recorder, flight = _make_observability(args, reg)
     spec_kw = _spec_kwargs(args, params, gpt_cfg)
+    mesh_kw = _mesh_kwargs(args)
     if tserver is not None and flight is not None:
         tserver.flight_provider = lambda: flight.snapshot("on_demand")
 
@@ -1381,6 +1600,7 @@ def main(argv=None) -> int:
                     default_deadline_s=args.deadline_s,
                     attrib=bool(args.attrib_json),
                     **spec_kw,
+                    **mesh_kw,
                     **_server_kwargs(args)),
                 n_replicas=args.replicas,
                 clock=WallClock(),
@@ -1408,6 +1628,7 @@ def main(argv=None) -> int:
                                  trace_recorder=recorder,
                                  attrib=bool(args.attrib_json),
                                  **spec_kw,
+                                 **mesh_kw,
                                  **_server_kwargs(args))
         if tserver is not None and args.attrib_json:
             tserver.attrib_provider = lambda: server.attrib_report()

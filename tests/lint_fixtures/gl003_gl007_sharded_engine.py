@@ -1,0 +1,84 @@
+"""GL003/GL007 fixtures — the hazards mesh-aware serving must avoid.
+
+The sharded DecodeEngine (serving/engine.py) makes the mesh part of
+each program family's COMPILE identity, not a traced input: the pool's
+``NamedSharding`` rides into the jit wrapper as a ``functools.partial``
+bound kwarg (static, exactly like ``cfg``), and the ``None``
+single-device branch lives in an un-jitted pin helper the traced body
+merely calls. Passing the sharding per call and branching on it inside
+the jitted body would specialise per value — the retrace the
+one-executable-per-family guarantee forbids. And any wait on a shard
+transfer must read the injected clock, never the wall, or the chaos
+tests stop being deterministic.
+
+Positives: a jitted body that takes the sharding as a call argument
+and branches on it; a traced live-lane branch; a wall-clock transfer
+deadline. Suppressed: one traced retry-while, inline disable.
+Negatives: the partial-bound sharding constant; the un-jitted pin
+helper's None branch; a branch on ``.sharding`` (trace-static
+attribute, like ``.shape``); the injected-clock deadline.
+"""
+import functools
+import time
+
+import jax
+
+POOL_SHARDING = object()  # stands in for the pool's NamedSharding
+
+
+def _pin(cache, kv_sharding):
+    if kv_sharding is None:  # clean: un-jitted helper — host branch
+        return cache
+    return {k: jax.lax.with_sharding_constraint(v, kv_sharding)
+            for k, v in cache.items()}
+
+
+def _decode_like(params, cache, kv_sharding=None):
+    new = {k: v + params for k, v in cache.items()}
+    return _pin(new, kv_sharding)
+
+
+# clean: the mesh-in-compile-key idiom — the sharding is a
+# partial-bound constant of the wrapper, so the wrapper IS the mesh
+# decision and the family keeps exactly one executable per engine
+decode_sharded = jax.jit(
+    functools.partial(_decode_like, kv_sharding=POOL_SHARDING))
+
+
+@jax.jit
+def decode_takes_sharding_per_call(cache, kv_sharding):
+    if kv_sharding is None:  # expect: GL003
+        return cache
+    return {k: jax.lax.with_sharding_constraint(v, kv_sharding)
+            for k, v in cache.items()}
+
+
+@jax.jit
+def prefill_branches_on_live_lanes(cache, n_live):
+    if n_live > 0:  # expect: GL003
+        return cache
+    return {k: v * 0 for k, v in cache.items()}
+
+
+@jax.jit
+def install_retries_traced(cache, tries):
+    while tries < 3:  # graftlint: disable=GL003
+        tries = tries + 1
+    return cache
+
+
+@jax.jit
+def repin_reads_static_sharding(cache, fallback):
+    # clean: ``.sharding`` is concrete at trace time (STATIC_ATTRS,
+    # like ``.shape``) — how the engine's pin helper stays branch-free
+    if cache["k"].sharding is None:
+        return fallback
+    return cache
+
+
+def transfer_deadline_bad(deadline):
+    return time.perf_counter() >= deadline  # expect: GL007
+
+
+def transfer_deadline_injected(clock, deadline):
+    return clock() >= deadline  # clean: the scheduler's injected clock

@@ -286,6 +286,31 @@ def test_speculative_module_clean_under_recompile_and_clock_rules():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_sharded_engine_modules_clean_under_recompile_and_clock_rules():
+    """ISSUE 14: serving/engine.py binds the pool's NamedSharding into
+    each jit wrapper as a partial-bound constant (mesh-in-compile-key:
+    one wrapper = one mesh = one executable per family) and keeps the
+    single-device None branch in the un-jitted ``_pin_kv`` helper — a
+    traced branch on the sharding (GL003) would specialise per value
+    and break the one-executable guarantee the sharded selftest pins.
+    engine.py and kv_pool.py are in GL007 scope (serving/) and must
+    also stay wall-clock clean — placement must never buy timing
+    nondeterminism. Both hold outright: no suppressions, no baseline
+    entries. The hazard shapes and the approved partial-bound idiom are
+    pinned by the gl003_gl007_sharded_engine.py fixture."""
+    paths = [
+        os.path.join(REPO, "mingpt_distributed_tpu", "serving", p)
+        for p in ("engine.py", "kv_pool.py")
+    ]
+    cfg = Engine(select=["GL003", "GL007"], root=REPO).config
+    for p in paths:
+        rel = os.path.relpath(p, REPO)
+        assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL003", "GL007"], root=REPO).run(paths)
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_trafficlab_package_clean_under_clock_rule():
     """ISSUE 12: the traffic lab's byte-replayable sweeps depend on
     arrival schedules being virtual-timestamp data and the runner never

@@ -19,7 +19,11 @@ Input kinds (both files must be the same kind):
   the metric name (latency-ish names are lower-is-better, mfu /
   throughput higher-is-better). A null value (no backend) or a failed
   round with no ``parsed`` block renders as n/a, never as a
-  regression.
+  regression. When both records carry the multichip extra's
+  ``sharded_serving`` block (ISSUE 14: per-device KV-pool bytes and
+  decode/prefill ms at tp=1 vs tp=2), its numeric leaves are diffed
+  too — bytes are exact layout facts, ``*_ms`` leaves get the timing
+  noise thresholds.
 
 Verdicts per metric: ``same`` | ``improved`` | ``regressed`` | ``n/a``
 (the ``diff_slo_reports`` vocabulary, with ``improved`` instead of
@@ -166,14 +170,61 @@ def diff_attrib_reports(
     }
 
 
+def _sharded_serving_rows(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    rel_tol: float,
+) -> List[Dict[str, Any]]:
+    """Diff rows for the multichip ``sharded_serving`` block when both
+    reports carry one (ISSUE 14); [] otherwise, so old BENCH files diff
+    exactly as before. Numeric leaves are flattened to dotted names
+    (``tp2.kv_pool_bytes_per_device``). Byte counts and ratios are
+    layout facts — exact, any drift is a real placement change; the
+    ``*_ms`` leaves are CPU timings and get the relative tolerance plus
+    a 0.05 ms floor. All leaves are lower-is-better (bytes per device
+    IS the metric the sharding exists to shrink)."""
+    sa = (a.get("multichip") or {}).get("sharded_serving")
+    sb = (b.get("multichip") or {}).get("sharded_serving")
+    if not (isinstance(sa, dict) and isinstance(sb, dict)):
+        return []
+
+    def _flatten(d, prefix=""):
+        out = {}
+        for k in sorted(d):
+            v = d[k]
+            if isinstance(v, dict):
+                out.update(_flatten(v, f"{prefix}{k}."))
+            elif isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"{prefix}{k}"] = float(v)
+        return out
+
+    fa, fb = _flatten(sa), _flatten(sb)
+    rows = []
+    for name in sorted(set(fa) | set(fb)):
+        timing = name.endswith("_ms")
+        cell = _verdict(
+            fa.get(name), fb.get(name),
+            rel_tol if timing else 1e-9,
+            0.05 if timing else 0.0,
+        )
+        rows.append({
+            "metric": f"sharded_serving.{name}",
+            "unit": "ms" if timing else None,
+            "direction": "lower_better",
+            **cell,
+        })
+    return rows
+
+
 def diff_bench_reports(
     a: Dict[str, Any],
     b: Dict[str, Any],
     rel_tol: float = 0.05,
 ) -> Dict[str, Any]:
-    """Diff two bench.py reports on their single parsed metric. A
-    report without a ``parsed`` block (a failed round) contributes a
-    null value — n/a, never a regression."""
+    """Diff two bench.py reports on their single parsed metric, plus
+    the multichip ``sharded_serving`` leaves when both reports have
+    them. A report without a ``parsed`` block (a failed round)
+    contributes a null value — n/a, never a regression."""
     pa = a.get("parsed") or {}
     pb = b.get("parsed") or {}
     name = pa.get("metric") or pb.get("metric") or "?"
@@ -185,17 +236,19 @@ def diff_bench_reports(
     lower = any(h in name for h in _LOWER_BETTER_HINTS)
     cell = _verdict(pa.get("value"), pb.get("value"), rel_tol, 0.0,
                     lower_better=lower)
-    row = {
+    rows = [{
         "metric": name,
         "unit": pa.get("unit"),
         "direction": "lower_better" if lower else "higher_better",
         **cell,
-    }
+    }]
+    rows.extend(_sharded_serving_rows(a, b, rel_tol))
     return {
         "schema": "mingpt-bench/1-diff",
         "rel_tol": rel_tol,
-        "metrics": [row],
-        "regressions": int(cell["verdict"] == "regressed"),
+        "metrics": rows,
+        "regressions": sum(
+            1 for r in rows if r["verdict"] == "regressed"),
     }
 
 
