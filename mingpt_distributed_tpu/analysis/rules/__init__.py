@@ -8,4 +8,5 @@ from mingpt_distributed_tpu.analysis.rules import (  # noqa: F401
     clock,
     metric_names,
     print_discipline,
+    sharding,
 )

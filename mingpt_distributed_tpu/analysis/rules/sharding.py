@@ -1,0 +1,60 @@
+"""GL011 — trailing-none-spec: a ``PartitionSpec`` authored with
+trailing ``None`` entries.
+
+The motivating incident (PR 12): the runtime normalizes sharding specs
+by stripping trailing ``None``s, and compiled-program outputs carry the
+normalized form — but executable caches and the serving engine key on
+sharding *equality*. A warmup cache placed under
+``PartitionSpec("tp", None)`` therefore compares unequal to the
+``PartitionSpec("tp")`` the first compiled call returns, and the next
+call on a "warmed" bucket silently compiles a second, identical
+executable. The fix is to never author the trailing ``None``: an
+unmentioned dimension already means replicated, so
+``P("pp", None)`` and ``P("pp")`` place identically — only the
+spelled form breaks equality. graftaudit (``analysis/hlo_audit.py``)
+checks the same invariant on the lowered artifacts; this rule catches
+it at review time.
+
+``P(None, "tp")`` is fine (the ``None`` is load-bearing: it positions
+``"tp"`` on a later dimension). ``P(None)`` and ``P(None, None)`` are
+just ``P()`` with extra steps, and are flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from mingpt_distributed_tpu.analysis.core import (
+    FileContext, Finding, Rule, register_rule,
+)
+from mingpt_distributed_tpu.analysis.jitutil import call_name
+
+
+@register_rule
+class TrailingNoneSpecRule(Rule):
+    id = "GL011"
+    name = "trailing-none-spec"
+    help = ("PartitionSpec authored with a trailing None — the runtime "
+            "strips it during normalization, so equality-keyed caches "
+            "see a novel sharding; drop it (unmentioned dims replicate)")
+
+    def check_file(self, ctx: FileContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for n in ast.walk(ctx.tree):
+            if not isinstance(n, ast.Call) or not n.args:
+                continue
+            fname = call_name(n.func)
+            if not fname:
+                continue
+            if fname != "P" and fname.split(".")[-1] != "PartitionSpec":
+                continue
+            last = n.args[-1]
+            if isinstance(last, ast.Constant) and last.value is None:
+                findings.append(self.finding(
+                    ctx, n,
+                    "PartitionSpec with a trailing None — the runtime "
+                    "normalizes it away and sharding-equality caches "
+                    "then mismatch (PR 12: spurious executables); drop "
+                    "the trailing None"))
+        return findings

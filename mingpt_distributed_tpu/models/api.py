@@ -22,6 +22,7 @@ import jax
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.models import generate as _generate
 from mingpt_distributed_tpu.models import gpt as _gpt
+from mingpt_distributed_tpu.telemetry.spans import log_event
 
 
 class GPT:
@@ -41,8 +42,9 @@ class GPT:
             else _gpt.init(jax.random.key(seed), self.config)
         )
         # construction-time report, as the reference prints param count +
-        # model MB (model.py:257-259)
-        print(_gpt.model_size_report(self.params, self.config))
+        # model MB (model.py:257-259) — routed through log_event so the
+        # line is process-prefixed and lands in the span ring (GL010)
+        log_event(_gpt.model_size_report(self.params, self.config))
 
     # -- torch-module-flavoured API ------------------------------------
     def forward(

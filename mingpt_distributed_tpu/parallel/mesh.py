@@ -152,12 +152,15 @@ def replicated(mesh: Mesh) -> NamedSharding:
 #   row-parallel   (wide -> d_model): input dim tp,   output dim fsdp
 # so a block's tp collectives are one all-gather + one reduce-scatter pair,
 # and fsdp gathers params just-in-time per layer (ZeRO-3 analogue via GSPMD).
+# Specs are authored in normalized form — no trailing Nones (GL011):
+# unmentioned trailing dims replicate, and the runtime strips trailing
+# Nones anyway, so the spelled form only breaks sharding-equality keys.
 PARAM_RULES: dict[str, P] = {
     "wte": P("fsdp", "tp"),
-    "wpe": P(None, None),
+    "wpe": P(),
     "head": P("tp", "fsdp"),
-    "lnf_scale": P(None),
-    "lnf_bias": P(None),
+    "lnf_scale": P(),
+    "lnf_bias": P(),
     # blocks (leading layer axis, sharded over pipeline stages; pp=1 = no-op)
     "wq": P("pp", "fsdp", "tp"),
     "wk": P("pp", "fsdp", "tp"),
@@ -171,16 +174,16 @@ PARAM_RULES: dict[str, P] = {
     "bq": P("pp", "tp"),
     "bk": P("pp", "tp"),
     "bv": P("pp", "tp"),
-    "bo": P("pp", None),
+    "bo": P("pp"),
     "b_fc": P("pp", "tp"),
-    "b_proj": P("pp", None),
-    "ln1_scale": P("pp", None),
-    "ln1_bias": P("pp", None),
-    "ln2_scale": P("pp", None),
-    "ln2_bias": P("pp", None),
+    "b_proj": P("pp"),
+    "ln1_scale": P("pp"),
+    "ln1_bias": P("pp"),
+    "ln2_scale": P("pp"),
+    "ln2_bias": P("pp"),
     # MoE (ops/moe.py): expert axis over ep; expert matrices additionally
     # fsdp/tp-sharded like their dense counterparts
-    "w_router": P("pp", None, None),
+    "w_router": P("pp"),
     "w_e1": P("pp", "ep", "fsdp", "tp"),
     "w_e2": P("pp", "ep", "tp", "fsdp"),
     "w_eg": P("pp", "ep", "fsdp", "tp"),

@@ -477,7 +477,8 @@ def ring_causal_attention(
     scale = 1.0 / math.sqrt(hd)
     # heads may be tensor-parallel; replicate over tp if indivisible
     head_ax = "tp" if h % mesh.shape.get("tp", 1) == 0 else None
-    spec = P(BATCH_AXES, "sp", head_ax, None)
+    # head_dim stays unmentioned (GL011: trailing dims replicate)
+    spec = P(BATCH_AXES, "sp", head_ax)
     shard = partial(_ring_shard, axis_name="sp", scale=scale,
                     window=None if window is None else int(window),
                     softcap=None if logit_softcap is None

@@ -107,7 +107,8 @@ def ulysses_causal_attention(
     kv = k.shape[2]
     k = attn_ops.repeat_kv(k, h // kv)
     v = attn_ops.repeat_kv(v, h // kv)
-    spec = P(BATCH_AXES, "sp", None, None)
+    # heads/head_dim stay unmentioned (GL011: trailing dims replicate)
+    spec = P(BATCH_AXES, "sp")
     shard = partial(_ulysses_shard, axis_name="sp",
                     window=None if window is None else int(window),
                     softcap=None if logit_softcap is None

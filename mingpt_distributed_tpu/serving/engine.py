@@ -559,3 +559,42 @@ class DecodeEngine:
                     family_prefix + "prefix_load", self._install_jit,
                     (self.pool.cache, entry, entry, np.int32(0)),
                     clock, variant=f"b{b}")
+
+    # -- static audit contracts (ISSUE 15) -----------------------------
+    def audit_contracts(self, family_prefix: str = "") -> Dict[str, dict]:
+        """Per-family contracts for ``analysis/hlo_audit.py`` — plain
+        dicts (serving never imports the analysis layer), keyed like
+        ``register_attrib`` families. Grammar (docs/static_analysis.md):
+
+        * ``allowed_collectives`` — collective op base names the lowered
+          HLO may contain. Model-forwarding families at tp > 1 reduce
+          partial matmul products over tp (``all-reduce``) and gather
+          small per-token activations (``all-gather``); the prefix copy
+          programs are chip-local row moves and allow nothing, at any tp.
+        * ``donated`` — exact ``input_output_alias`` entry count the
+          executable must carry: 2 (the donated cache's k and v leaves)
+          for prefill/decode/prefix_load, 0 for prefix_save (extract
+          donates nothing — the pool must survive the read).
+        * ``kv_output_sharding`` — the normalized NamedSharding every
+          returned cache/entry leaf must carry (None = single device).
+        * ``pool_leaf_elems`` — element count of one K/V pool buffer; a
+          collective result at least this large is moving the pool, which
+          no contract ever allows.
+        """
+        facts = self.pool.audit_facts()
+        tp = (1 if self.mesh is None
+              else int(self.mesh.shape.get(self.tp_axis, 1)))
+        model = {
+            "allowed_collectives":
+                ("all-gather", "all-reduce") if tp > 1 else (),
+            "donated": 2,
+            "kv_output_sharding": self.kv_sharding,
+            "pool_leaf_elems": facts["cache_leaf_elems"],
+        }
+        copy = dict(model, allowed_collectives=())
+        return {
+            family_prefix + "prefill": dict(model),
+            family_prefix + "decode": dict(model),
+            family_prefix + "prefix_save": dict(copy, donated=0),
+            family_prefix + "prefix_load": dict(copy),
+        }

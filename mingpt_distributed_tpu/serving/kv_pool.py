@@ -84,6 +84,20 @@ class SlotKVPool:
         shard = self.sharding.shard_shape(shape)
         return math.prod(shape) // math.prod(shard)
 
+    def audit_facts(self) -> dict:
+        """Static facts graftaudit checks pool-touching programs against
+        (plain dict so serving never imports the analysis layer):
+        ``cache_leaf_elems`` is the element count of one K/V buffer — any
+        collective whose result is at least that large is moving the pool
+        itself, not a per-token activation; ``cache_sharding`` is the
+        runtime-normalized NamedSharding every compiled program must
+        return the cache under (None on a single device)."""
+        return {
+            "cache_leaf_elems": math.prod(tuple(self.cache["k"].shape)),
+            "cache_sharding": self.sharding,
+            "shard_count": self.shard_count,
+        }
+
     @property
     def free_count(self) -> int:
         return len(self._free)

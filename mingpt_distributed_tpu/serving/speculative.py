@@ -314,3 +314,15 @@ class SpeculativeDecoder:
             clock, variant=f"k{self.k}")
         self.draft.engine.register_attrib(ledger, clock,
                                           family_prefix="draft_")
+
+    def audit_contracts(self) -> Dict[str, dict]:
+        """Audit contracts (ISSUE 15) for the families
+        ``register_attrib`` registers: verify is a model-forwarding
+        family on the target engine — same collectives/donation/sharding
+        contract as the target's prefill — and the draft families are
+        the draft engine's own contracts under the ``draft_`` prefix."""
+        verify = dict(self.target.audit_contracts()["prefill"])
+        return {
+            "verify": verify,
+            **self.draft.engine.audit_contracts(family_prefix="draft_"),
+        }

@@ -217,9 +217,30 @@ class ProgramLedger:
         variant: str = "",
         kwargs: Optional[Dict[str, Any]] = None,
     ) -> None:
-        compile_s, flops, byts = timed_aot_compile(
-            jit_fn, args, clock, kwargs=kwargs)
-        self.observe_compile(family, compile_s, flops, byts, variant=variant)
+        t0 = clock()
+        lowered = jit_fn.lower(*args, **(kwargs or {}))
+        compiled = lowered.compile()
+        t1 = clock()
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:  # backends without cost models still attribute
+            cost = None
+        flops, byts = _cost_to_flops_bytes(cost)
+        self.observe_compile(family, t1 - t0, flops, byts, variant=variant)
+        self.observe_lowered(family, variant, lowered, compiled)
+
+    def observe_lowered(
+        self, family: str, variant: str, lowered: Any, compiled: Any,
+    ) -> None:
+        """Hook: every ``register_aot`` hands the lowered + compiled
+        artifacts here before dropping them. The base ledger keeps only
+        the cost numbers (holding HLO text for every family would pin
+        megabytes for the server's lifetime); subclasses that audit the
+        lowered programs — ``analysis/hlo_audit.AuditLedger`` — override
+        this to capture text, aliasing and output shardings. Registration
+        seams stay unchanged: anything that knows how to
+        ``register_attrib`` against a ProgramLedger is auditable for
+        free."""
 
     # -- invocation sampling -------------------------------------------
     def observe_call(

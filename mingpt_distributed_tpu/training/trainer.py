@@ -545,6 +545,28 @@ class GPTTrainer:
             hbm.account("opt_state", zero_lib.opt_moment_bytes(
                 params_abs, self.zero_plan))
 
+    def audit_contracts(self) -> dict:
+        """Audit contract (ISSUE 15) for the ``train_step`` family
+        ``register_attrib`` registers. On a one-device mesh the lowered
+        step must contain no collectives at all; on a real mesh the data/
+        tensor/zero parallel forms all appear (psum grads, zero's
+        reduce-scatter + all-gather, megatron gathers), so every reduce-
+        family op is declared. Donation is ``donate_argnums=(0,)`` over
+        the whole train state: the executable must alias at least one
+        output per params leaf (``donated_min`` — opt-state leaves alias
+        too, but their count depends on the optimizer/zero layout, so the
+        params floor is the invariant worth pinning)."""
+        n_dev = int(np.prod(self.mesh.devices.shape))
+        allowed = (() if n_dev == 1 else
+                   ("all-gather", "all-reduce", "collective-permute",
+                    "reduce-scatter"))
+        return {
+            "train_step": {
+                "allowed_collectives": allowed,
+                "donated_min": len(jax.tree.leaves(self.state["params"])),
+            },
+        }
+
     def _data_feed_shards(self, global_batch: int, seq_len: int):
         """(n_shards, my_shard) for host data feeding.
 

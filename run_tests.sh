@@ -28,6 +28,33 @@ cd "$(dirname "$0")"
 env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     python -m mingpt_distributed_tpu.analysis
 
+# graftaudit gate (ISSUE 15): AOT-lower every lifetime program family on a
+# tiny config (never executing the model) and statically verify the lowered
+# HLO — collectives inventory vs each family's contract, donation aliasing
+# actually present, authored-vs-output sharding equality, and exact-match
+# cost budgets against committed program_budgets.json (bless intentional
+# changes with tools/graftaudit.py --update-budgets). tp=2 runs on 2 forced
+# host devices and must additionally be byte-identical across two runs —
+# the audit itself is deterministic. Manual rm (no trap: the chaos gate's
+# OBS_DIR trap below would clobber an earlier one).
+GA_DIR="$(mktemp -d)"
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python tools/graftaudit.py --tp 1 --json > "$GA_DIR/tp1.json"
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python tools/graftaudit.py --tp 2 --json > "$GA_DIR/tp2_a.json"
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS="--xla_force_host_platform_device_count=2" \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python tools/graftaudit.py --tp 2 --json > "$GA_DIR/tp2_b.json"
+cmp "$GA_DIR/tp2_a.json" "$GA_DIR/tp2_b.json"
+rm -rf "$GA_DIR"
+
 # ZeRO parity gate (ISSUE 9): on a dp=2 host-platform mesh, training with
 # zero_dp (reduce-scatter grads -> 1/dp-local clip/Adam/decay -> allgather
 # params) must reproduce the replicated baseline's losses and parameters

@@ -1,0 +1,29 @@
+"""GL011 fixtures — PartitionSpec authored with trailing None.
+
+Positives: trailing None on jax.sharding.PartitionSpec, on a P alias,
+and the all-None spec.
+Suppressed: one trailing-None spec, inline disable.
+Negatives: interior None (load-bearing: positions a later axis), empty
+spec, starred args (not statically a trailing None).
+"""
+from jax.sharding import PartitionSpec
+from jax.sharding import PartitionSpec as P
+
+
+def bad_specs(tp_axis):
+    full = PartitionSpec("tp", None)  # expect: GL011
+    alias = P(None, None, None, tp_axis, None)  # expect: GL011
+    all_none = P(None)  # expect: GL011
+    return full, alias, all_none
+
+
+def suppressed_spec():
+    # interop with an external checkpoint layout that spells head_dim
+    return P("tp", None)  # graftlint: disable=GL011
+
+
+def good_specs(dims):
+    interior = P(None, "tp")  # clean: None positions tp on dim 1
+    replicated = PartitionSpec()  # clean: the normalized empty spec
+    dynamic = P(*dims)  # clean: not statically a trailing None
+    return interior, replicated, dynamic

@@ -1,0 +1,234 @@
+#!/usr/bin/env python
+"""graftaudit CLI — static audit of every lowered program family
+(ISSUE 15 tentpole; checks live in ``analysis/hlo_audit.py``).
+
+Usage: python tools/graftaudit.py [--tp {1,2}] [--json]
+           [--budgets program_budgets.json] [--no-budgets]
+           [--update-budgets]
+
+Builds the canonical tiny serving + speculation stack (the
+``serve.py --selftest-sharded`` config) — and, on the tp=1 sweep, the
+tiny trainer — then AOT-lowers every program family through the
+attribution ``register_attrib`` seams into an
+:class:`~mingpt_distributed_tpu.analysis.hlo_audit.AuditLedger` and
+checks the lowered artifacts against the families' declared contracts:
+collectives inventory, donation aliasing, output-sharding drift and
+exact ``cost_analysis`` budgets. Nothing is ever executed on the model
+(params are initialised, programs are only lowered + compiled).
+
+Sweeps: ``--tp 1`` is the single-device audit (every family must lower
+with zero collectives); ``--tp 2`` runs the same serving stack across a
+forced-2-device mesh (``XLA_FLAGS=--xla_force_host_platform_device_count=2``
+on CPU) and proves the tp contracts: reduce-family ops only, no
+gathered KV pool, donation aliasing intact, normalized sharding specs.
+
+Budgets: ``program_budgets.json`` commits the exact flops /
+bytes-accessed per program per sweep. Drift is a finding;
+``--update-budgets`` re-records the current sweep's section (bless an
+intentional program change, then commit the file).
+``tools/perf_diff.py old.json new.json`` renders a budgets diff.
+
+Exit codes mirror graftlint: 0 clean, 1 findings, 2 usage/build error.
+The ``--json`` envelope (``graftaudit/1``) is byte-identical across
+consecutive runs — run_tests.sh ``cmp``s two tp=2 runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+
+
+def _repo_import():
+    """Running this file directly puts tools/ on sys.path; make the
+    repo root importable like perf_diff does."""
+    try:
+        import mingpt_distributed_tpu  # noqa: F401
+    except ImportError:
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _build_serving(tp: int):
+    """The canonical audit config: the selftest-sharded tiny GPT, a
+    2-slot engine with a {8, 48} prefill ladder and the prefix store on
+    (so the copy families register), plus a k=2 speculative decoder
+    whose draft is the same tiny model."""
+    import jax
+
+    from mingpt_distributed_tpu.config import GPTConfig, MeshConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.parallel.mesh import make_mesh
+    from mingpt_distributed_tpu.serving.engine import DecodeEngine
+    from mingpt_distributed_tpu.serving.speculative import SpeculativeDecoder
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    mesh = (make_mesh(MeshConfig(tp=tp), devices=jax.devices()[:tp])
+            if tp > 1 else None)
+    engine = DecodeEngine(
+        params, cfg, n_slots=2, prefill_buckets=(8, 48),
+        prefix_cache_mb=0.5, mesh=mesh,
+    )
+    spec = SpeculativeDecoder(engine, params, cfg, k=2)
+    return engine, spec
+
+
+def _build_trainer(tmpdir: str):
+    """Tiny single-device trainer so the train_step family is audited
+    on the tp=1 sweep (dense variant; the zero/dp forms need a multi-dp
+    mesh and stay covered by their own selftests)."""
+    import jax
+    import numpy as np  # noqa: F401  (kept: trainer deps import numpy)
+
+    from mingpt_distributed_tpu.config import (
+        DataConfig,
+        GPTConfig,
+        MeshConfig,
+        OptimizerConfig,
+        TrainerConfig,
+    )
+    from mingpt_distributed_tpu.data.char_dataset import CharDataset
+    from mingpt_distributed_tpu.parallel import mesh as mesh_lib
+    from mingpt_distributed_tpu.training.trainer import GPTTrainer
+
+    corpus = ("graftaudit lowers the train step to audit collectives "
+              "and aliasing; it never runs it. " * 24)
+    ds = CharDataset(
+        DataConfig(path="<inline>", block_size=16, train_split=0.9),
+        text=corpus)
+    train, test = ds.split()
+    gcfg = GPTConfig.make(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=ds.vocab_size,
+        block_size=16, embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0,
+        dtype="float32",
+    )
+    tcfg = TrainerConfig.make(
+        max_epochs=1, batch_size=16, grad_norm_clip=1.0, save_every=100,
+        log_every=1000, seed=7,
+        snapshot_path=os.path.join(tmpdir, "snap.msgpack"),
+    )
+    mesh = mesh_lib.make_mesh(
+        MeshConfig(pp=1, dp=1, fsdp=1, ep=1, tp=1, sp=1),
+        devices=jax.devices()[:1])
+    return GPTTrainer(
+        tcfg, gcfg, OptimizerConfig(learning_rate=1e-2), train, test,
+        mesh=mesh)
+
+
+def _load_budgets(path: str):
+    """The committed budgets doc, or a fresh skeleton when the file
+    does not exist yet. Raises ValueError on a wrong-schema file."""
+    from mingpt_distributed_tpu.analysis.hlo_audit import BUDGETS_SCHEMA
+
+    if not os.path.exists(path):
+        return {"schema": BUDGETS_SCHEMA, "sweeps": {}}
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != BUDGETS_SCHEMA:
+        raise ValueError(
+            f"{path}: not a {BUDGETS_SCHEMA} document "
+            f"(schema={doc.get('schema')!r})")
+    if not isinstance(doc.get("sweeps"), dict):
+        raise ValueError(f"{path}: sweeps must be an object")
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftaudit", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--tp", type=int, default=1, choices=(1, 2),
+                    help="tensor-parallel extent of the audited mesh "
+                         "(2 needs >= 2 devices)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the graftaudit/1 envelope instead of the "
+                         "human rendering")
+    ap.add_argument("--budgets", default="program_budgets.json",
+                    metavar="FILE",
+                    help="committed cost-budget baseline "
+                         "(default: %(default)s)")
+    ap.add_argument("--no-budgets", action="store_true",
+                    help="skip the cost-budget check")
+    ap.add_argument("--update-budgets", action="store_true",
+                    help="re-record this sweep's budgets in FILE "
+                         "(bless an intentional program change)")
+    args = ap.parse_args(argv)
+
+    _repo_import()
+    from mingpt_distributed_tpu.analysis.hlo_audit import (
+        AuditLedger,
+        audit_exit_code,
+        audit_programs,
+        build_audit_report,
+        build_budget_section,
+        check_budgets,
+        dump_audit_report,
+        render_audit_human,
+        validate_audit_report,
+    )
+
+    import jax
+
+    if args.tp > 1 and len(jax.devices()) < args.tp:
+        print(f"graftaudit: --tp {args.tp} needs >= {args.tp} devices, "
+              f"found {len(jax.devices())} (on CPU run under XLA_FLAGS="
+              f"--xla_force_host_platform_device_count={args.tp})",
+              file=sys.stderr)
+        return 2
+
+    # Build + registration chatter (log_event, sharding telemetry) goes
+    # to stderr so --json stdout stays a single parseable document.
+    ledger = AuditLedger()
+    clock = lambda: 0.0  # noqa: E731 — no timing may enter the report
+    with contextlib.redirect_stdout(sys.stderr), \
+            tempfile.TemporaryDirectory() as tmpdir:
+        engine, spec = _build_serving(args.tp)
+        engine.register_attrib(ledger, clock)
+        spec.register_attrib(ledger, clock)
+        contracts = {**engine.audit_contracts(), **spec.audit_contracts()}
+        if args.tp == 1:
+            trainer = _build_trainer(tmpdir)
+            trainer.register_attrib(ledger, clock)
+            contracts.update(trainer.audit_contracts())
+
+    findings = audit_programs(ledger.artifacts, contracts)
+    sweep_key = f"tp{args.tp}"
+    try:
+        budgets_doc = _load_budgets(args.budgets)
+    except (OSError, ValueError) as e:
+        print(f"graftaudit: {e}", file=sys.stderr)
+        return 2
+    if args.update_budgets:
+        budgets_doc["sweeps"][sweep_key] = build_budget_section(
+            ledger.artifacts)
+        with open(args.budgets, "w") as f:
+            json.dump(budgets_doc, f, sort_keys=True, indent=2)
+            f.write("\n")
+        print(f"graftaudit: recorded {sweep_key} budgets for "
+              f"{len(ledger.artifacts)} programs in {args.budgets}",
+              file=sys.stderr)
+    if not args.no_budgets:
+        findings = sorted(
+            findings + check_budgets(
+                ledger.artifacts, budgets_doc["sweeps"].get(sweep_key)),
+            key=lambda x: x.sort_key)
+
+    report = build_audit_report(
+        {"tp": args.tp, "devices": args.tp, "budgets_file": args.budgets},
+        ledger.artifacts, contracts, findings)
+    validate_audit_report(report)
+    print(dump_audit_report(report) if args.json
+          else render_audit_human(report))
+    return audit_exit_code(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

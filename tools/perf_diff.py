@@ -24,6 +24,10 @@ Input kinds (both files must be the same kind):
   decode/prefill ms at tp=1 vs tp=2), its numeric leaves are diffed
   too — bytes are exact layout facts, ``*_ms`` leaves get the timing
   noise thresholds.
+* ``graftaudit-budgets/1`` documents (``program_budgets.json``, ISSUE
+  15): exact-match semantics on every ``sweep.program.metric`` leaf —
+  budgets are compiled-program properties, so no tolerance applies and
+  any flops/bytes growth is a regression.
 
 Verdicts per metric: ``same`` | ``improved`` | ``regressed`` | ``n/a``
 (the ``diff_slo_reports`` vocabulary, with ``improved`` instead of
@@ -42,6 +46,7 @@ import sys
 from typing import Any, Dict, List, Optional
 
 ATTRIB_SCHEMA = "mingpt-attrib/1"
+BUDGETS_SCHEMA = "graftaudit-budgets/1"
 
 #: attrib metrics compared per program row, in render order. The bool
 #: is "timing?": timing metrics get the noise thresholds, exact ones
@@ -71,9 +76,11 @@ def _telemetry():
 
 
 def classify(path: str, doc: Any) -> str:
-    """'attrib' | 'bench' (ValueError otherwise)."""
+    """'attrib' | 'bench' | 'budgets' (ValueError otherwise)."""
     if isinstance(doc, dict) and doc.get("schema") == ATTRIB_SCHEMA:
         return "attrib"
+    if isinstance(doc, dict) and doc.get("schema") == BUDGETS_SCHEMA:
+        return "budgets"
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict) \
             and "metric" in doc["parsed"]:
         return "bench"
@@ -216,6 +223,55 @@ def _sharded_serving_rows(
     return rows
 
 
+def diff_budget_reports(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+) -> Dict[str, Any]:
+    """Diff two graftaudit ``program_budgets.json`` documents (ISSUE 15).
+
+    Budgets are ``cost_analysis`` flops / bytes-accessed per program per
+    sweep — properties of the compiled program, not measurements — so
+    the comparison is EXACT: no relative tolerance, no absolute floor,
+    any drift is a real program change. Both metrics are lower-is-better
+    (a rewrite that halves decode bytes is an improvement; one that
+    doubles them is the regression this diff exists to name). A program
+    present on only one side renders n/a, never a regression — adding or
+    retiring a family is an audit-coverage event, not a perf one."""
+    for label, doc in (("a", a), ("b", b)):
+        if doc.get("schema") != BUDGETS_SCHEMA or \
+                not isinstance(doc.get("sweeps"), dict):
+            raise ValueError(
+                f"report {label}: not a {BUDGETS_SCHEMA} document")
+
+    def _flatten(doc):
+        out = {}
+        for sweep in sorted(doc["sweeps"]):
+            for prog, metrics in sorted(doc["sweeps"][sweep].items()):
+                for metric in ("flops", "bytes_accessed"):
+                    v = (metrics or {}).get(metric)
+                    out[f"{sweep}.{prog}.{metric}"] = (
+                        None if v is None else float(v))
+        return out
+
+    fa, fb = _flatten(a), _flatten(b)
+    rows = []
+    for name in sorted(set(fa) | set(fb)):
+        cell = _verdict(fa.get(name), fb.get(name), 1e-9, 0.0)
+        rows.append({
+            "metric": name,
+            "unit": None,
+            "direction": "lower_better",
+            **cell,
+        })
+    return {
+        "schema": f"{BUDGETS_SCHEMA}-diff",
+        "rel_tol": 0.0,
+        "metrics": rows,
+        "regressions": sum(
+            1 for r in rows if r["verdict"] == "regressed"),
+    }
+
+
 def diff_bench_reports(
     a: Dict[str, Any],
     b: Dict[str, Any],
@@ -315,6 +371,8 @@ def main(argv=None) -> int:
             diff = diff_attrib_reports(
                 docs[0], docs[1], rel_tol=args.rel_tol,
                 abs_floor_s=args.abs_floor_s)
+        elif kinds[0] == "budgets":
+            diff = diff_budget_reports(docs[0], docs[1])
         else:
             diff = diff_bench_reports(
                 docs[0], docs[1], rel_tol=args.rel_tol)
