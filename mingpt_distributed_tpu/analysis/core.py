@@ -112,6 +112,12 @@ class Config:
     # virtual-timestamp data and the runner never reads a wall clock)
     clock_paths: Tuple[str, ...] = (
         "serving/",
+        # redundant with serving/ by prefix, but pinned explicitly: the
+        # procfleet chaos suite is sleep-free ONLY because process-level
+        # faults land as clock skew / raised verdicts, never wall sleeps
+        # (socket timeouts are connection attributes, not time.* calls,
+        # and stay allowed)
+        "serving/procfleet/",
         "training/faults.py",
         "telemetry/tracing.py",
         "telemetry/flightrec.py",

@@ -37,6 +37,12 @@ what a server needs on top of it:
   idempotent retry, deadline-aware load shedding and graceful drain;
   request state (requests.py) split from slot state so a request can
   outlive the replica serving it.
+* ``ProcessSupervisor`` / ``ProcRouter`` (procfleet/) — the same fleet
+  machinery with the failure domain moved to an OS process: replicas
+  are spawned subprocesses behind a versioned ``mingpt-rpc/1`` HTTP
+  surface (with a deterministic in-process loopback twin for chaos
+  tests), SIGKILL-able crash detection via the socket + waitpid, and
+  live KV/prefix migration so a drain loses zero admitted requests.
 
 Everything is CPU-testable with a tiny config (tests/test_serving.py,
 tests/test_fleet.py) and driven end-to-end by ``serve.py`` at the repo
@@ -56,6 +62,12 @@ from mingpt_distributed_tpu.serving.fleet import (
     default_server_factory,
 )
 from mingpt_distributed_tpu.serving.kv_pool import PrefixKVStore, SlotKVPool
+from mingpt_distributed_tpu.serving.procfleet import (
+    ProcRouter,
+    ProcessSupervisor,
+    loopback_backend_factory,
+    process_backend_factory,
+)
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
 from mingpt_distributed_tpu.serving.requests import (
     QueueFullError,
@@ -78,6 +90,8 @@ __all__ = [
     "FleetHandle",
     "InferenceServer",
     "PrefixKVStore",
+    "ProcRouter",
+    "ProcessSupervisor",
     "QueueFullError",
     "Replica",
     "ReplicaSupervisor",
@@ -92,4 +106,6 @@ __all__ = [
     "VirtualClock",
     "WallClock",
     "default_server_factory",
+    "loopback_backend_factory",
+    "process_backend_factory",
 ]

@@ -453,6 +453,56 @@ class DecodeEngine:
         stored = self.prefix_store.insert(key, (lane["k"], lane["v"]))
         return rows if stored else 0
 
+    # -- live migration (ISSUE 16) -------------------------------------
+    def migratable_rows(self, prompt_len: int, frontier: int) -> int:
+        """Rows worth shipping for a slot whose cache holds ``frontier``
+        valid leading rows of an ``prompt_len``-token prompt: the largest
+        ladder bucket <= min(frontier, prompt_len - 1) — capped at the
+        frontier (a mid-prefill slot only has real K/V up to there; the
+        stale-row invariant makes everything past it garbage) and one
+        short of the prompt (a hit on the peer must leave >= 1 tail token
+        to prefill). Collapses to ``quantized_prefix_len`` for a slot
+        that finished prefilling. 0 = nothing shippable."""
+        cap = min(frontier, prompt_len - 1)
+        best = 0
+        for b in self.buckets:
+            if b <= cap:
+                best = b
+        return best
+
+    def extract_slot_rows(self, slot: int, rows: int):
+        """Pull ``rows`` leading K/V rows out of ``slot`` as a pinned
+        (L, 1, rows, KV, hd) entry — the extract half of live migration,
+        through the SAME row-copy program family ``save_prefix`` uses.
+        ``rows`` must sit on the bucket ladder so this never grows the
+        bounded prefix-copy family past one trace per bucket."""
+        if rows not in self.buckets:
+            raise ValueError(
+                f"extract rows {rows} not on the bucket ladder "
+                f"{self.buckets} — migration must reuse the compiled "
+                f"prefix-copy programs, not mint new ones")
+        lane = self._extract_jit(self.pool.cache, np.int32(slot), rows=rows)
+        return lane["k"], lane["v"]
+
+    def adopt_prefix_entry(self, key: Sequence[int], k, v) -> bool:
+        """Install a migrated prefix entry (host arrays off the transfer
+        channel) into THIS engine's prefix store, re-placed under the
+        pool's sharding so entries stay head-sharded on device exactly
+        like locally-saved ones. Returns False when the store is
+        disabled, full, or already holds the key."""
+        if self.prefix_store is None:
+            return False
+        key = tuple(int(t) for t in key)
+        if self.prefix_store.contains(key):
+            return False
+        if self.kv_sharding is not None:
+            k = jax.device_put(k, self.kv_sharding)
+            v = jax.device_put(v, self.kv_sharding)
+        else:
+            k = jnp.asarray(k)
+            v = jnp.asarray(v)
+        return self.prefix_store.insert(key, (k, v))
+
     # -- warmup --------------------------------------------------------
     def warmup(self) -> None:
         """Pre-trace the full program family so no request pays a compile:

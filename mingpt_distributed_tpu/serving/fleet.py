@@ -327,6 +327,10 @@ class ReplicaSupervisor:
     replica; past the budget the replica stays down (flapping hardware
     should not be hammered forever)."""
 
+    #: Replica wrapper class — subclasses swap in a different isolation
+    #: boundary (procfleet's ProcReplica) without copying the lifecycle.
+    replica_cls = Replica
+
     def __init__(
         self,
         server_factory: Callable[..., InferenceServer],
@@ -347,9 +351,10 @@ class ReplicaSupervisor:
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
         self.replicas = [
-            Replica(f"replica{i}", i, server_factory, self.clock, injector,
-                    queue_high_watermark=queue_high_watermark,
-                    itl_slo_s=itl_slo_s)
+            self.replica_cls(
+                f"replica{i}", i, server_factory, self.clock, injector,
+                queue_high_watermark=queue_high_watermark,
+                itl_slo_s=itl_slo_s)
             for i in range(n_replicas)
         ]
         r = self.registry

@@ -1,0 +1,79 @@
+"""procfleet — process-isolated replicas over a real socket boundary
+with live KV/prefix migration (ISSUE 16).
+
+Layering (each module imports only downward):
+
+* :mod:`.rpc` — the ``mingpt-rpc/1`` envelope grammar + strict
+  validator, the request wire form, and the size-framed transfer
+  channel for migrated KV/prefix state.
+* :mod:`.transport` — the Transport seam: :class:`SocketTransport`
+  (real HTTP to a subprocess) and :class:`LoopbackTransport` (the
+  byte-faithful deterministic in-process twin).
+* :mod:`.worker` — one ``InferenceServer`` behind the RPC surface:
+  the step-driven endpoint table, chunked token streaming, migration
+  export/import, and the subprocess entry point (hello handshake,
+  SIGTERM → exit 75).
+* :mod:`.supervisor` — :class:`ProcReplica` / :class:`ProcessSupervisor`
+  / :class:`ProcRouter`: the in-process fleet machinery re-based onto
+  the boundary, plus ``migrate_and_drain`` live migration.
+"""
+
+from mingpt_distributed_tpu.serving.procfleet.rpc import (
+    EnvelopeError,
+    FRAME_MAGIC,
+    RPC_SCHEMA,
+    TransportError,
+    TransportTimeout,
+    envelope,
+    pack_frames,
+    request_from_wire,
+    request_to_wire,
+    unpack_frames,
+    validate_envelope,
+)
+from mingpt_distributed_tpu.serving.procfleet.supervisor import (
+    LoopbackBackend,
+    ProcReplica,
+    ProcRouter,
+    ProcessBackend,
+    ProcessSupervisor,
+    ReplicaUnreachable,
+    ServerProxy,
+    loopback_backend_factory,
+    process_backend_factory,
+)
+from mingpt_distributed_tpu.serving.procfleet.transport import (
+    LoopbackTransport,
+    SocketTransport,
+)
+from mingpt_distributed_tpu.serving.procfleet.worker import (
+    ReplicaWorker,
+    RpcHttpServer,
+)
+
+__all__ = [
+    "EnvelopeError",
+    "FRAME_MAGIC",
+    "LoopbackBackend",
+    "LoopbackTransport",
+    "ProcReplica",
+    "ProcRouter",
+    "ProcessBackend",
+    "ProcessSupervisor",
+    "RPC_SCHEMA",
+    "ReplicaUnreachable",
+    "ReplicaWorker",
+    "RpcHttpServer",
+    "ServerProxy",
+    "SocketTransport",
+    "TransportError",
+    "TransportTimeout",
+    "envelope",
+    "loopback_backend_factory",
+    "pack_frames",
+    "process_backend_factory",
+    "request_from_wire",
+    "request_to_wire",
+    "unpack_frames",
+    "validate_envelope",
+]

@@ -1,0 +1,733 @@
+"""Process-isolated fleet: supervisor, router and live migration over
+the procfleet RPC boundary (ISSUE 16 tentpole).
+
+The in-process fleet (``serving/fleet.py``) already has the hard parts —
+breakers, bounded retry, token-index dedup, health-gated placement, the
+crash→backoff→respawn lifecycle. This module swaps its *failure domain*
+from "a Python object we drop" to "an OS process we SIGKILL" without
+rewriting any of that machinery:
+
+* :class:`ServerProxy` — duck-types the slice of ``InferenceServer``
+  the Router and Replica wrappers actually touch (``queue``, ``slots``,
+  ``metrics``, ``watchdog``, ``submit``/``step``, ``on_token``) and
+  forwards each call across a :class:`~.transport.SocketTransport` or
+  deterministic :class:`~.transport.LoopbackTransport`. The worker is
+  **step-driven**: ``step()`` asks the replica for one scheduling round
+  and applies the returned event batch to local handle mirrors, so the
+  router's round loop, reconcile pass and dedup emitter run verbatim.
+
+* :class:`ProcReplica` — a :class:`~.fleet.Replica` whose ``_spawn``
+  produces a backend (subprocess or in-process loopback twin) instead of
+  a server object. Liveness is the socket plus the OS: a dead process
+  answers its next RPC with a connection error, which ``step()``
+  translates to :class:`~.faults.ProcessKilled` (a ``ReplicaCrashed``)
+  so the router's crash path — trip breaker, mark crashed, retry victims
+  through dedup — applies unchanged. The supervisor additionally reaps
+  the corpse: waitpid exit code (negative = signal) and the flight
+  recorder dumps left in the dead replica's spill directory.
+
+* :class:`ProcRouter.migrate_and_drain` — live migration. The source
+  ships its prefix-store entries and the bucket-quantized leading rows
+  of every in-flight slot through the size-framed transfer channel; the
+  destination installs them under its own pool sharding (entries stay
+  head-sharded on device). In-flight requests re-route from their
+  ORIGINAL prompts — the same retry-idempotency invariant that makes
+  crash recovery token-exact — so the migrated stream is bit-identical
+  while the shipped rows turn the re-prefill into a device-side row
+  copy. The drained process exits ``REQUEUE_EXIT_CODE`` (75): the
+  scheduler-requeue contract now holds per replica process.
+
+Nothing in this module reads the wall clock: fleet time is the injected
+clock, process liveness is ``waitpid``, and socket timeouts (an OS I/O
+deadline, not a ``time.*`` call) bound real-transport RPCs.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Any, Callable, Dict, List, Optional
+
+from mingpt_distributed_tpu.serving.fleet import (
+    REQUEUE_EXIT_CODE,
+    Replica,
+    ReplicaHealth,
+    ReplicaSupervisor,
+    Router,
+)
+from mingpt_distributed_tpu.serving.procfleet.rpc import (
+    EnvelopeError,
+    TransportError,
+    TransportTimeout,
+    envelope,
+    request_to_wire,
+)
+from mingpt_distributed_tpu.serving.procfleet.transport import (
+    LoopbackTransport,
+    SocketTransport,
+)
+from mingpt_distributed_tpu.serving.requests import QueueFullError
+from mingpt_distributed_tpu.telemetry import (
+    MetricsRegistry,
+    merge_fleet_pages,
+    render_prometheus,
+)
+from mingpt_distributed_tpu.training.faults import (
+    InjectedAdmissionError,
+    ProcessFaultInjector,
+    ProcessKilled,
+)
+
+__all__ = [
+    "ProcReplica",
+    "ProcRouter",
+    "ProcessBackend",
+    "ProcessSupervisor",
+    "ReplicaUnreachable",
+    "ServerProxy",
+    "LoopbackBackend",
+    "loopback_backend_factory",
+    "process_backend_factory",
+]
+
+
+class ReplicaUnreachable(InjectedAdmissionError):
+    """submit() could not reach the replica process. Subclasses the
+    admission-fault type so the router's existing admit-retry path
+    (breaker failure + try the next candidate) handles it — the request
+    is NOT lost, and the crash is confirmed by the next step RPC."""
+
+
+# ---------------------------------------------------------------------
+# InferenceServer proxy (the duck-typed slice the fleet layer touches)
+# ---------------------------------------------------------------------
+
+class _SizedQueue:
+    """len()-able stand-in for the worker's request queue."""
+
+    def __init__(self):
+        self._n = 0
+
+    def set(self, n: int) -> None:
+        self._n = int(n)
+
+    def __len__(self) -> int:
+        return self._n
+
+
+class _ProxySlots:
+    occupied = 0
+
+
+class _ProxyMetrics:
+    """Mirrors the two latency numbers health/shedding read, plus an
+    empty private registry (renders as an empty page — the real page is
+    fetched over /metrics)."""
+
+    def __init__(self):
+        self.itl_mean_s: Optional[float] = None
+        self.itl_p99_s: Optional[float] = None
+        self.registry = MetricsRegistry()
+
+
+class _ProxyWatchdog:
+    def __init__(self):
+        self.recompiles = 0
+        self.on_recompile = None  # router wires this; fired via step RPC
+
+
+class ServerProxy:
+    """Client half of the step-driven contract: one of these per live
+    backend, holding local :class:`RequestHandle` mirrors that the
+    router's dedup emitter and reconcile pass consume exactly as they
+    would in-process handles."""
+
+    def __init__(self, transport, name: str, clock: Callable[[], float]):
+        self.transport = transport
+        self.name = name
+        self.clock = clock
+        self.queue = _SizedQueue()
+        self.slots = _ProxySlots()
+        self.metrics = _ProxyMetrics()
+        self.watchdog = _ProxyWatchdog()
+        self.on_token = None          # set by Router._wire_replica
+        self.trace_recorder = None    # set by Router._wire_replica (unused:
+        #                               the router owns spans and events)
+        self.attrib = None            # truthy when the worker has a ledger
+        self._handles: Dict[str, Any] = {}
+        self._recompiles_seen = 0
+
+    # -- submit ---------------------------------------------------------
+    def submit(self, request):
+        from mingpt_distributed_tpu.serving.requests import RequestHandle
+
+        doc = envelope("submit", request=request_to_wire(request))
+        try:
+            resp = self.transport.call("/rpc/submit", doc)
+        except TransportError as e:
+            raise ReplicaUnreachable(
+                f"replica {self.name} unreachable at submit: {e}") from e
+        if resp["kind"] == "error":
+            err, msg = resp["error"], resp["message"]
+            if err == "queue_full":
+                raise QueueFullError(
+                    msg, queue_depth=resp.get("queue_depth"),
+                    retry_after_s=resp.get("retry_after_s"))
+            if err in ("admit", "draining"):
+                raise InjectedAdmissionError(msg)
+            if err == "invalid":
+                raise ValueError(msg)
+            raise RuntimeError(f"submit to {self.name} failed: {err}: {msg}")
+        if resp["kind"] != "submit_result":
+            raise EnvelopeError(
+                f"submit answered with {resp['kind']!r}")
+        rh = RequestHandle(
+            request=request,
+            request_id=resp["request_id"],
+            prompt_used=[int(t) for t in request.prompt],
+            max_new_effective=request.max_new_tokens,
+            submit_time=self.clock(),
+        )
+        self._handles[rh.request_id] = rh
+        self.queue.set(resp["queue_depth"])
+        return rh
+
+    # -- one scheduling round --------------------------------------------
+    def step(self) -> bool:
+        resp = self.transport.call("/rpc/step", envelope("step"))
+        if resp["kind"] == "error":
+            # a poisoned round worker-side: replica alive, round lost —
+            # surfaces as the router's generic step-failure (breaker
+            # failure, recompute next round)
+            raise RuntimeError(
+                f"step on {self.name} failed: {resp['error']}: "
+                f"{resp['message']}")
+        if resp["kind"] != "step_result":
+            raise EnvelopeError(f"step answered with {resp['kind']!r}")
+        now = self.clock()
+        for ev in resp["events"]:
+            rh = self._handles.get(ev["request_id"])
+            if rh is None:
+                continue  # finished + reconciled in an earlier round
+            if ev["type"] == "emit":
+                if ev["token_index"] != len(rh.tokens):
+                    raise EnvelopeError(
+                        f"{self.name}: emit for {ev['request_id']} at "
+                        f"index {ev['token_index']}, expected "
+                        f"{len(rh.tokens)} — stream drift across the "
+                        f"boundary")
+                rh.tokens.append(ev["token"])
+                if rh.first_token_time is None:
+                    rh.first_token_time = now
+                rh.last_token_time = now
+                if self.on_token is not None:
+                    self.on_token(rh, ev["token"])
+            else:  # "finish"
+                rh.finished = True
+                rh.finish_reason = ev["finish_reason"]
+                if ev["finish_reason"] == "error":
+                    rh.error = RuntimeError(
+                        ev.get("error", "replica-side error"))
+                del self._handles[ev["request_id"]]
+        self.queue.set(resp["queue_depth"])
+        self.slots.occupied = resp["occupied"]
+        self.watchdog.recompiles = resp["recompiles"]
+        self.metrics.itl_mean_s = resp.get("itl_mean_s")
+        self.metrics.itl_p99_s = resp.get("itl_p99_s")
+        if (self.watchdog.recompiles > self._recompiles_seen
+                and self.watchdog.on_recompile is not None):
+            self.watchdog.on_recompile(
+                self.watchdog.recompiles - self._recompiles_seen)
+        self._recompiles_seen = self.watchdog.recompiles
+        return bool(resp["busy"])
+
+    # -- the rest of the surface the fleet layer touches -----------------
+    def cancel(self, request_id: str) -> bool:
+        resp = self.transport.call(
+            "/rpc/cancel", envelope("cancel", request_id=request_id))
+        return bool(resp.get("cancelled"))
+
+    def attrib_report(self, include_live: bool = False) -> Dict[str, Any]:
+        # live (uncommitted) call spans never cross the boundary — the
+        # worker reports committed attribution only
+        return self.transport.fetch_json("/attrib")
+
+    def metrics_page(self) -> str:
+        return self.transport.fetch_text("/metrics")
+
+    def health_doc(self) -> Dict[str, Any]:
+        return self.transport.call("/rpc/health")
+
+
+# ---------------------------------------------------------------------
+# Backends: what "a replica" physically is
+# ---------------------------------------------------------------------
+
+class LoopbackBackend:
+    """The deterministic twin: a ReplicaWorker held in-process behind
+    LoopbackTransport. Same byte-level RPC path, no sockets, no
+    processes; kill/term emulate the OS verdicts (-9 / 75) so chaos
+    reports are shape-identical across the seam."""
+
+    kind = "loopback"
+    pid = None
+
+    def __init__(self, worker, spill_dir: Optional[str] = None,
+                 attrib_enabled: bool = False):
+        self.worker = worker
+        self.transport = LoopbackTransport(worker)
+        self.spill_dir = spill_dir
+        self.attrib_enabled = attrib_enabled
+        self._exit_code: Optional[int] = None
+
+    def alive(self) -> bool:
+        return self._exit_code is None
+
+    def sigkill(self) -> None:
+        if self._exit_code is None:
+            self._exit_code = -9
+            self.transport.close()
+
+    def sigterm(self) -> None:
+        if self._exit_code is None:
+            if self.worker.flight is not None:
+                self.worker.flight.dump(
+                    "drain", replica=self.worker.name,
+                    unfinished=len(self.worker.server.unfinished()))
+            self._exit_code = REQUEUE_EXIT_CODE
+            self.transport.close()
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[int]:
+        return self._exit_code
+
+    def exit_code(self) -> Optional[int]:
+        return self._exit_code
+
+    def spill_dumps(self) -> List[str]:
+        if not self.spill_dir:
+            return []
+        return sorted(glob.glob(os.path.join(self.spill_dir,
+                                             "flight-*.json")))
+
+
+class ProcessBackend:
+    """A spawned worker subprocess + its socket transport. Exit codes
+    follow waitpid convention: negative is the killing signal (-9 for
+    SIGKILL), 75 is the drain/requeue contract."""
+
+    kind = "process"
+
+    def __init__(self, proc: subprocess.Popen, transport: SocketTransport,
+                 pid: int, spill_dir: str, attrib_enabled: bool = False):
+        self.proc = proc
+        self.transport = transport
+        self.pid = pid
+        self.spill_dir = spill_dir
+        self.attrib_enabled = attrib_enabled
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def sigkill(self) -> None:
+        if self.alive():
+            self.proc.kill()
+
+    def sigterm(self) -> None:
+        if self.alive():
+            self.proc.terminate()
+
+    def wait(self, timeout_s: Optional[float] = None) -> Optional[int]:
+        try:
+            return self.proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            return None
+
+    def exit_code(self) -> Optional[int]:
+        return self.proc.poll()
+
+    def spill_dumps(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.spill_dir,
+                                             "flight-*.json")))
+
+
+def loopback_backend_factory(params, cfg, spill_root: Optional[str] = None,
+                             **server_kwargs):
+    """Backend factory for the deterministic seam: each spawn builds a
+    full in-process InferenceServer (on the replica's SkewedClock, with
+    the supervisor's serving-fault hook) wrapped in a ReplicaWorker."""
+    from mingpt_distributed_tpu.serving.procfleet.worker import ReplicaWorker
+    from mingpt_distributed_tpu.serving.scheduler import InferenceServer
+
+    spawn_counts: Dict[str, int] = {}
+
+    def make(name: str, clock, fault_hook) -> LoopbackBackend:
+        n = spawn_counts.get(name, 0)
+        spawn_counts[name] = n + 1
+        server = InferenceServer(params, cfg, clock=clock,
+                                 fault_hook=fault_hook, **server_kwargs)
+        flight = None
+        spill_dir = None
+        if spill_root is not None:
+            spill_dir = os.path.join(spill_root, f"{name}-s{n}")
+            os.makedirs(spill_dir, exist_ok=True)
+            from mingpt_distributed_tpu.telemetry.flightrec import (
+                FlightRecorder,
+            )
+            flight = FlightRecorder(capacity=256, out_dir=spill_dir,
+                                    registry=server.metrics.registry)
+        worker = ReplicaWorker(server, name=name, flight=flight)
+        if flight is not None:
+            # same on-disk evidence a real worker leaves at startup, so
+            # a SIGKILL'd loopback replica still has a spill to collect
+            flight.dump("spawn", replica=name, spawn=n)
+        return LoopbackBackend(worker, spill_dir=spill_dir,
+                               attrib_enabled=server.attrib is not None)
+
+    return make
+
+
+def process_backend_factory(spec_base: Dict[str, Any], spill_root: str,
+                            rpc_timeout_s: float = 60.0):
+    """Backend factory for real isolation: writes the worker spec under a
+    per-spawn spill directory, spawns ``python -m ...procfleet.worker``,
+    performs the hello handshake on the child's stdout, and binds a
+    SocketTransport to the advertised ephemeral port. ``fault_hook`` is
+    ignored — serving faults cannot cross the process boundary as
+    closures; put them in ``spec_base["serving_faults"]`` and the worker
+    builds its own injector."""
+
+    spawn_counts: Dict[str, int] = {}
+
+    def make(name: str, clock, fault_hook) -> ProcessBackend:
+        n = spawn_counts.get(name, 0)
+        spawn_counts[name] = n + 1
+        spill_dir = os.path.join(spill_root, f"{name}-s{n}")
+        os.makedirs(spill_dir, exist_ok=True)
+        spec = dict(spec_base, name=name, spill_dir=spill_dir)
+        spec_path = os.path.join(spill_dir, "spec.json")
+        with open(spec_path, "w") as f:
+            json.dump(spec, f, sort_keys=True)
+        stderr_path = os.path.join(spill_dir, "stderr.log")
+        with open(stderr_path, "wb") as errf:
+            proc = subprocess.Popen(
+                [sys.executable, "-m",
+                 "mingpt_distributed_tpu.serving.procfleet.worker",
+                 spec_path],
+                stdout=subprocess.PIPE, stderr=errf, text=True)
+        line = proc.stdout.readline()  # blocks until hello or child EOF
+        if not line:
+            code = proc.wait()
+            tail = ""
+            try:
+                with open(stderr_path) as f:
+                    tail = f.read()[-2000:]
+            except OSError:
+                pass
+            raise RuntimeError(
+                f"worker {name} died before hello (exit {code}); stderr "
+                f"tail:\n{tail}")
+        from mingpt_distributed_tpu.serving.procfleet.rpc import (
+            validate_envelope,
+        )
+        hello = validate_envelope(json.loads(line), kind="hello")
+        transport = SocketTransport("127.0.0.1", hello["port"],
+                                    timeout_s=rpc_timeout_s)
+        health = transport.call("/rpc/health")
+        return ProcessBackend(proc, transport, pid=hello["pid"],
+                              spill_dir=spill_dir,
+                              attrib_enabled=bool(health.get("attrib")))
+
+    return make
+
+
+# ---------------------------------------------------------------------
+# ProcReplica
+# ---------------------------------------------------------------------
+
+class ProcReplica(Replica):
+    """A Replica whose server lives behind the RPC boundary. The
+    ``server_factory`` contract changes shape: it returns a *backend*
+    (LoopbackBackend or ProcessBackend), and the Replica wraps it in a
+    ServerProxy — everything above (submit, step, load, health) keeps
+    the base types."""
+
+    backend = None
+    pinj: Optional[ProcessFaultInjector] = None
+    draining = False
+
+    def _spawn(self) -> ServerProxy:
+        hook = (self.injector.round_hook(self.name)
+                if self.injector is not None else None)
+        self.backend = self._factory(name=self.name, clock=self.clock,
+                                     fault_hook=hook)
+        proxy = ServerProxy(self.backend.transport, self.name,
+                            clock=self.clock)
+        if self.backend.attrib_enabled:
+            proxy.attrib = True
+        return proxy
+
+    def respawn(self) -> None:
+        old = self.backend
+        if old is not None:
+            if old.alive():
+                old.sigkill()
+                old.wait(timeout_s=10.0)
+            old.transport.close()
+        self.draining = False
+        super().respawn()
+
+    def step(self) -> bool:
+        if self.injector is not None:
+            # in-process "slow" faults land as clock skew, same as the
+            # thread fleet; crash-grade serving faults fire worker-side
+            self.clock.skew_s += self.injector.step_delay(self.name)
+        if self.pinj is not None:
+            try:
+                self.clock.skew_s += self.pinj.rpc_verdict(self.name)
+            except ProcessKilled:
+                # the fault IS the process dying: make it true, then let
+                # the crash propagate through the normal path
+                self.backend.sigkill()
+                self.backend.wait(timeout_s=10.0)
+                raise
+            # InjectedHang propagates: replica alive, round lost — the
+            # router's step-failure path records a breaker failure
+        try:
+            return self.server.step()
+        except TransportTimeout:
+            raise  # lost round, process presumed alive
+        except TransportError as e:
+            self.backend.wait(timeout_s=10.0)
+            raise ProcessKilled(
+                f"replica {self.name} process died mid-step "
+                f"(exit={self.backend.exit_code()}): {e}") from e
+
+    def health(self) -> ReplicaHealth:
+        if self.state == "drained":
+            return ReplicaHealth(False, ["drained"])
+        h = super().health()
+        if self.draining and h.ready:
+            return ReplicaHealth(False, ["draining"])
+        return h
+
+    def reap(self) -> Dict[str, Any]:
+        """Post-mortem of the current backend: exit code (waitpid
+        convention) + the flight-recorder dumps the dead worker spilled."""
+        b = self.backend
+        if b is None:
+            return {}
+        if b.alive():
+            b.wait(timeout_s=10.0)
+        return {"backend": b.kind, "pid": b.pid,
+                "exit_code": b.exit_code(),
+                "spill_dumps": b.spill_dumps()}
+
+    def shutdown(self, timeout_s: float = 10.0) -> Dict[str, Any]:
+        """Graceful retirement: SIGTERM, wait for the requeue exit (75),
+        escalate to SIGKILL only if the worker ignores the contract."""
+        b = self.backend
+        if b is None:
+            return {}
+        b.sigterm()
+        code = b.wait(timeout_s=timeout_s)
+        if code is None:
+            b.sigkill()
+            b.wait(timeout_s=timeout_s)
+        b.transport.close()
+        return {"backend": b.kind, "pid": b.pid,
+                "exit_code": b.exit_code(),
+                "spill_dumps": b.spill_dumps()}
+
+
+# ---------------------------------------------------------------------
+# ProcessSupervisor
+# ---------------------------------------------------------------------
+
+class ProcessSupervisor(ReplicaSupervisor):
+    """ReplicaSupervisor over ProcReplica: the same backoff/budget
+    lifecycle, plus OS-level crash forensics (exit codes, spill dumps)
+    and the process-restart / migration counters."""
+
+    replica_cls = ProcReplica
+
+    def __init__(self, backend_factory, n_replicas: int = 2, clock=None,
+                 injector=None, process_injector=None, registry=None,
+                 **kwargs):
+        super().__init__(backend_factory, n_replicas=n_replicas,
+                         clock=clock, injector=injector,
+                         registry=registry, **kwargs)
+        self.process_injector = process_injector
+        for rep in self.replicas:
+            rep.pinj = process_injector
+        r = self.registry
+        self._proc_restarts = r.counter(
+            "mingpt_fleet_process_restarts_total",
+            help="replica worker processes respawned after a process "
+                 "death (subset of mingpt_fleet_restarts_total where the "
+                 "failure domain was the OS process)",
+            labels=("replica",))
+        self._migrations = r.counter(
+            "mingpt_fleet_migrations_total",
+            help="live KV/prefix migrations by outcome (ok = state "
+                 "shipped and installed; failed = transfer failed, "
+                 "requests still recovered by plain re-route)",
+            labels=("outcome",))
+        for rep in self.replicas:
+            self._proc_restarts.labels(replica=rep.name).inc(0)
+        for outcome in ("ok", "failed"):
+            self._migrations.labels(outcome=outcome).inc(0)
+        #: post-mortems collected at mark_crashed time, in crash order
+        self.crash_reports: List[Dict[str, Any]] = []
+        #: replica name -> exit code recorded at graceful retirement
+        self.drained_exits: Dict[str, Optional[int]] = {}
+
+    def mark_crashed(self, replica) -> None:
+        super().mark_crashed(replica)
+        self.crash_reports.append(
+            {"replica": replica.name, **replica.reap()})
+
+    def poll_restarts(self):
+        restarted = super().poll_restarts()
+        for rep in restarted:
+            self._proc_restarts.labels(replica=rep.name).inc()
+        return restarted
+
+    def retire_replica(self, replica) -> Dict[str, Any]:
+        """Graceful, terminal shutdown (post-migration): the replica
+        leaves the routable set for good — no restart is scheduled, and
+        its exit code (75 per the requeue contract) is recorded."""
+        info = replica.shutdown()
+        self.drained_exits[replica.name] = info.get("exit_code")
+        replica.state = "drained"
+        self._restart_due.pop(replica.name, None)
+        self._up.labels(replica=replica.name).set(0)
+        self._healthy.labels(replica=replica.name).set(0)
+        return info
+
+    def shutdown_all(self) -> Dict[str, Optional[int]]:
+        """Terminate every live backend (end of serving / test teardown)."""
+        for rep in self.replicas:
+            if rep.state != "drained" and rep.backend is not None \
+                    and rep.backend.alive():
+                info = rep.shutdown()
+                self.drained_exits.setdefault(
+                    rep.name, info.get("exit_code"))
+        return dict(self.drained_exits)
+
+
+# ---------------------------------------------------------------------
+# ProcRouter
+# ---------------------------------------------------------------------
+
+class ProcRouter(Router):
+    """Router over a ProcessSupervisor. Placement additionally skips
+    draining replicas; fleet observability is fetched over the RPC
+    surface (a subprocess's private registry is not importable); and
+    ``migrate_and_drain`` implements live migration."""
+
+    def _candidates(self, fh):
+        return [rep for rep in super()._candidates(fh)
+                if not getattr(rep, "draining", False)]
+
+    def fleet_metrics_page(self) -> str:
+        """Merged Prometheus page: the shared supervisor/router registry
+        plus every live replica's /metrics page fetched over RPC and
+        re-labelled under ``replica=<name>`` — ONE TYPE line per family,
+        same output contract as the in-process fleet page."""
+        pages: Dict[str, str] = {}
+        for rep in self.supervisor.replicas:
+            if rep.state != "ready" or rep.backend is None:
+                continue
+            try:
+                pages[rep.name] = rep.backend.transport.fetch_text(
+                    "/metrics")
+            except TransportError:
+                continue  # dying replica: its crash path will run next
+        return merge_fleet_pages(
+            render_prometheus(self.supervisor.registry), pages)
+
+    def migrate_and_drain(self, src_name: str,
+                          dst_name: Optional[str] = None) -> Dict[str, Any]:
+        """Drain ``src_name`` with zero loss: ship its prefix/KV state to
+        a peer, re-route every in-flight request (bit-identical streams
+        via the retry-idempotency invariant + dedup), then retire the
+        source process (exit 75). Returns a ``mingpt-migrate/1`` report.
+
+        A failed transfer degrades, never loses: the counter records
+        ``outcome="failed"`` and the in-flight requests still re-route —
+        they merely re-prefill from scratch on the peer."""
+        src = self.supervisor.replica_by_name(src_name)
+        if src is None or src.state != "ready":
+            raise ValueError(
+                f"cannot migrate from {src_name!r}: not a ready replica")
+        src.draining = True  # no new placements while state ships
+        if dst_name is not None:
+            dst = self.supervisor.replica_by_name(dst_name)
+        else:
+            peers = [r for r in self.supervisor.ready_replicas()
+                     if r.name != src_name
+                     and not getattr(r, "draining", False)]
+            dst = min(peers, key=lambda r: (r.load, r.index),
+                      default=None)
+        if dst is None or dst.state != "ready" or dst.name == src_name:
+            src.draining = False
+            raise ValueError(
+                f"no migration destination for {src_name!r}")
+        now = self.clock.now()
+        outcome, installed, skipped, error = "ok", 0, 0, None
+        try:
+            blob = src.backend.transport.fetch_bytes("/rpc/migrate_out")
+            resp = dst.backend.transport.post_bytes("/rpc/migrate_in",
+                                                    blob)
+            if resp.get("kind") != "migrate_in_result":
+                raise EnvelopeError(
+                    f"migrate_in answered with {resp.get('kind')!r}: "
+                    f"{resp.get('message')}")
+            installed = resp["installed"]
+            skipped = resp["skipped"]
+        except (TransportError, EnvelopeError) as e:
+            outcome, error = "failed", repr(e)
+        self.supervisor._migrations.labels(outcome=outcome).inc()
+        # re-route every in-flight attempt from its ORIGINAL prompt; the
+        # dedup emitter suppresses indices the caller already saw, so
+        # the visible stream stays append-only and token-exact
+        moved: List[str] = []
+        for key in [k for k in self._attempts if k[0] == src_name]:
+            fh, rh = self._attempts.pop(key)
+            if rh.finished:
+                self._resolve_finished(src_name, fh, rh, crashed=False)
+                continue
+            self._close_attempt_span(fh, rh, "migrated")
+            if self.trace_recorder is not None and fh.trace is not None:
+                self.trace_recorder.add_event(
+                    fh.trace, "migrate", now,
+                    from_replica=src_name, to_replica=dst.name)
+                self.trace_recorder.mark_forced(fh.trace)
+            self._pending.append((fh, now))
+            moved.append(fh.request_id)
+        try:
+            src.backend.transport.call(
+                "/rpc/drain", envelope("drain", migrate=True))
+        except TransportError:
+            pass  # already unreachable; retirement reaps it either way
+        info = self.supervisor.retire_replica(src)
+        self._update_gauges()
+        report = {
+            "schema": "mingpt-migrate/1",
+            "from": src_name,
+            "to": dst.name,
+            "outcome": outcome,
+            "error": error,
+            "entries_installed": installed,
+            "entries_skipped": skipped,
+            "requests_moved": sorted(moved),
+            "src_exit_code": info.get("exit_code"),
+        }
+        if self.flight is not None:
+            self.flight.dump("migration",
+                             **{k: v for k, v in report.items()
+                                if k != "schema"})
+        return report

@@ -507,6 +507,24 @@ class InferenceServer:
             return True
         return False
 
+    def cancel(self, request_id: str) -> bool:
+        """Terminate one accepted-but-unfinished request (ISSUE 16: the
+        procfleet RPC cancel endpoint): a queued request leaves the queue,
+        an in-flight one frees its slot. Either way the handle finishes
+        with reason "cancelled" and the error counter ticks — a cancel is
+        a non-success outcome, not a completion. Returns False when no
+        live request carries the id (already finished, or never here)."""
+        for h in list(self.queue):
+            if h.request_id == request_id and not h.finished:
+                self.queue.remove(h)
+                self._fail(h, "cancelled")
+                return True
+        for h in self.slots.live_handles():
+            if h.request_id == request_id and not h.finished:
+                self._fail(h, "cancelled")
+                return True
+        return False
+
     def _admit(self, handle: RequestHandle) -> None:
         """Claim a slot and start admission: a shared-prefix hit installs
         its rows now (device copy); prompt tokens beyond it prefill in the

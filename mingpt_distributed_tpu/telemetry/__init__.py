@@ -54,6 +54,7 @@ from mingpt_distributed_tpu.telemetry.export import (
     SCHEMA_VERSION,
     JsonlEventSink,
     TelemetryServer,
+    merge_fleet_pages,
     parse_prometheus,
     register_build_info,
     render_fleet_prometheus,
@@ -149,6 +150,7 @@ __all__ = [
     "load_flight_dir",
     "load_trace_jsonl",
     "log_event",
+    "merge_fleet_pages",
     "parse_prometheus",
     "parse_slo_spec",
     "peak_flops_per_chip",

@@ -35,6 +35,7 @@ __all__ = [
     "SCHEMA_VERSION",
     "JsonlEventSink",
     "TelemetryServer",
+    "merge_fleet_pages",
     "parse_prometheus",
     "register_build_info",
     "render_fleet_prometheus",
@@ -321,6 +322,73 @@ def parse_prometheus(text: str) -> Dict[str, Any]:
                     f"{rec['count']}"
                 )
     return {"types": types, "samples": samples}
+
+
+def merge_fleet_pages(
+    base_page: Optional[str],
+    replica_pages: Dict[str, str],
+) -> str:
+    """Fleet merge over ALREADY-RENDERED exposition pages (ISSUE 16).
+
+    :func:`render_fleet_prometheus` merges live ``MetricsRegistry``
+    objects — which only works while every replica shares the router's
+    process. A process-isolated fleet has nothing but each replica's
+    ``/metrics`` TEXT as fetched over its RPC socket; this merges those
+    pages under the same contract: ONE HELP/TYPE header per family (the
+    strict parser rejects duplicate TYPE lines, so naive concatenation
+    is not an option), a ``replica`` label injected on every replica
+    sample, a kind conflict anywhere in the fleet raises, and output is
+    sorted (families, then base-before-replicas in sorted replica order)
+    so identical inputs render byte-identically. Every input page is
+    strict-parsed first — a replica shipping a malformed page fails the
+    merge loudly instead of corrupting the fleet scrape."""
+    sources: List[Tuple[Optional[str], str]] = []
+    if base_page is not None:
+        sources.append((None, base_page))
+    sources.extend(sorted(replica_pages.items()))
+    kinds: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    fam_samples: Dict[str, List[Tuple[Optional[str], str,
+                                      Dict[str, str], float]]] = {}
+    for replica, page in sources:
+        parsed = parse_prometheus(page)
+        for fam, kind in parsed["types"].items():
+            prev = kinds.get(fam)
+            if prev is not None and prev != kind:
+                raise ValueError(
+                    f"fleet page merge: family {fam!r} is {kind} on "
+                    f"{replica or 'base'} but {prev} elsewhere — "
+                    f"exposition would be incoherent")
+            kinds[fam] = kind
+            fam_samples.setdefault(fam, [])
+        for line in page.splitlines():
+            m = _HELP_RE.match(line)
+            if m and m.group(1) not in helps:
+                helps[m.group(1)] = m.group(2)
+        for name, labels, value in parsed["samples"]:
+            fam = name
+            if fam not in parsed["types"]:
+                for suffix in ("_bucket", "_sum", "_count"):
+                    stem = name[: -len(suffix)]
+                    if name.endswith(suffix) and stem in parsed["types"]:
+                        fam = stem
+                        break
+            if fam not in parsed["types"]:
+                raise ValueError(
+                    f"fleet page merge: sample {name!r} on "
+                    f"{replica or 'base'} has no TYPE header")
+            fam_samples[fam].append((replica, name, labels, value))
+    out: List[str] = []
+    for fam in sorted(kinds):
+        if helps.get(fam):
+            # help text comes off the wire already escaped — verbatim
+            out.append(f"# HELP {fam} {helps[fam]}")
+        out.append(f"# TYPE {fam} {kinds[fam]}")
+        for replica, name, labels, value in fam_samples[fam]:
+            if replica is not None:
+                labels = {"replica": replica, **labels}
+            out.append(_sample(name, labels, value))
+    return "\n".join(out) + "\n"
 
 
 def register_build_info(registry: MetricsRegistry):

@@ -41,6 +41,17 @@ filters on the replica name (``match=replica0``); the env knob is
 
     MINGPT_SERVING_FAULTS="crash:nth=6:match=replica0;slow:every=1:delay=0.25:match=replica1" \\
         python serve.py --replicas 3 ...
+
+Process fault points (ISSUE 16): :class:`ProcessFaultInjector` drives the
+process-isolated fleet (``serving/procfleet``) with ops that sabotage the
+RPC boundary instead of the scheduling loop — ``kill`` (the replica
+process dies as if SIGKILLed; over a real socket the supervisor actually
+sends SIGKILL), ``hang`` (one RPC times out; the replica survives, the
+round is lost), ``slow_socket`` (the RPC is slow: virtual clock skew on
+the deterministic loopback transport, or an injectable ``sleep`` per the
+``RetryPolicy.sleep`` idiom when a real socket is in play). ``match``
+filters on the replica name; the env knob is
+``MINGPT_PROCESS_FAULTS``.
 """
 
 from __future__ import annotations
@@ -58,12 +69,15 @@ from fsspec import AbstractFileSystem
 ENV_VAR = "MINGPT_FAULTS"
 ENV_TARGET = "MINGPT_FAULT_TARGET"
 SERVING_ENV_VAR = "MINGPT_SERVING_FAULTS"
+PROCESS_ENV_VAR = "MINGPT_PROCESS_FAULTS"
 
 #: Filesystem fault points (the original grammar) vs serving fault points
-#: (fleet chaos harness). One FaultSpec grammar covers both; which set an
-#: injector accepts is validated at construction.
+#: (fleet chaos harness) vs process fault points (procfleet RPC boundary).
+#: One FaultSpec grammar covers all three; which set an injector accepts
+#: is validated at construction.
 IO_OPS = ("write", "read")
 SERVING_OPS = ("crash", "poison", "slow", "admit")
+PROCESS_OPS = ("kill", "hang", "slow_socket")
 
 
 @dataclass
@@ -81,12 +95,12 @@ class FaultSpec:
     count: int = field(default=0, compare=False)
 
     def __post_init__(self):
-        if self.op not in IO_OPS + SERVING_OPS:
+        known = IO_OPS + SERVING_OPS + PROCESS_OPS
+        if self.op not in known:
             raise ValueError(
-                f"fault op must be one of {IO_OPS + SERVING_OPS}, "
-                f"got {self.op!r}")
-        if self.op == "slow" and self.mode == "error":
-            # "slow" only makes sense as a delay; default the mode so
+                f"fault op must be one of {known}, got {self.op!r}")
+        if self.op in ("slow", "slow_socket") and self.mode == "error":
+            # slowness only makes sense as a delay; default the mode so
             # specs read naturally ("slow:every=1:delay=0.25")
             self.mode = "delay"
         if self.mode not in ("error", "truncate", "delay", "missing"):
@@ -366,6 +380,83 @@ class ServingFaultInjector:
         if self._fire("admit", replica) is not None:
             raise InjectedAdmissionError(
                 f"injected admission failure on replica {replica}")
+
+
+class ProcessKilled(ReplicaCrashed):
+    """The replica *process* died (SIGKILL-grade: no goodbye over the
+    socket). Subclasses :class:`ReplicaCrashed` so the router's crash
+    path — trip breaker, mark crashed, retry victims — applies
+    unchanged; the process supervisor additionally reaps the corpse and
+    collects its flight-recorder spill."""
+
+
+class InjectedHang(InjectedServingFault):
+    """One RPC to the replica timed out (socket-level hang). The process
+    is still alive; the round is lost, the breaker records a failure —
+    the same contract as a poisoned in-process round."""
+
+
+class ProcessFaultInjector:
+    """Deterministic fault schedule over the procfleet RPC boundary,
+    sharing :class:`FaultSpec`'s grammar and counters with the other
+    injectors. ``match`` filters on the replica name. One fault point:
+
+    * ``rpc_verdict(replica)`` — before each step RPC. Raises
+      :class:`ProcessKilled` for a due ``kill`` (over a real socket the
+      supervisor turns this into an actual SIGKILL of the subprocess),
+      raises :class:`InjectedHang` for a due ``hang``, and returns the
+      injected delay seconds for a due ``slow_socket`` (0.0 otherwise).
+
+    ``sleep`` is injectable per the ``RetryPolicy.sleep`` idiom: the
+    deterministic loopback transport leaves it ``None`` and lands the
+    delay as clock skew (nobody sleeps); a real-socket fleet may pass
+    ``time.sleep`` so slowness is physically observable end-to-end."""
+
+    def __init__(self, faults: Optional[str] = None,
+                 sleep: Optional[Callable[[float], None]] = None):
+        text = faults if faults is not None else os.environ.get(
+            PROCESS_ENV_VAR, "")
+        self.specs = parse_faults(text)
+        for s in self.specs:
+            if s.op not in PROCESS_OPS:
+                raise ValueError(
+                    f"process fault op must be one of {PROCESS_OPS}, "
+                    f"got {s.op!r} (serving ops belong in "
+                    f"{SERVING_ENV_VAR})")
+        self.sleep = sleep
+        self.fired: List[str] = []  # "(op, replica)" audit trail
+
+    def _fire(self, op: str, replica: str) -> Optional[FaultSpec]:
+        for s in self.specs:
+            if s.fires(op, replica):
+                self.fired.append(f"{op}:{replica}")
+                return s
+        return None
+
+    def reset_counters(self) -> None:
+        for s in self.specs:
+            s.count = 0
+        self.fired = []
+
+    def rpc_verdict(self, replica: str) -> float:
+        """Kill/hang/slow verdict for one RPC round against ``replica``.
+        Raises ProcessKilled or InjectedHang, or returns injected delay
+        seconds. When ``sleep`` was injected the delay is slept here and
+        0.0 is returned (real-socket mode); otherwise the caller adds it
+        to the replica's clock skew (deterministic loopback mode)."""
+        if self._fire("kill", replica) is not None:
+            raise ProcessKilled(
+                f"injected kill: replica process {replica} died")
+        if self._fire("hang", replica) is not None:
+            raise InjectedHang(
+                f"injected hang: RPC to replica {replica} timed out")
+        spec = self._fire("slow_socket", replica)
+        if spec is None:
+            return 0.0
+        if self.sleep is not None:
+            self.sleep(spec.delay_s)
+            return 0.0
+        return spec.delay_s
 
 
 def register() -> None:

@@ -149,6 +149,25 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
         --slo "ttft_p99<=60,itl_p99<=60,shed_rate<=0.5" \
         --slo-json "$OBS_DIR/slo.json"
 
+# Process-isolation gate (ISSUE 16): the same resiliency story with the
+# failure domain moved to an OS process — two REAL replica subprocesses
+# behind the mingpt-rpc/1 socket surface. kill -9 one mid-decode: every
+# request must still finish greedy token-identical to solo generate()
+# with zero duplicate or lost tokens in the caller-visible stream, the
+# supervisor must reap exit -9 and collect the dead worker's flight
+# spill, and the respawn must be a new pid. Then drain-with-migration:
+# the source ships its KV/prefix entries to the peer, retires with exit
+# 75 (the requeue contract now applies per replica process), in-flight
+# requests complete bit-identical to an undisturbed run, and each
+# migrated request's strict-validated mingpt-trace/1 timeline spans both
+# replicas. Also exercises the chunked /rpc/stream endpoint and the
+# fleet /metrics page merged over RPC (migration + process-restart
+# counters). Exits non-zero on any violation.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-procfleet --spill-dir "$OBS_DIR/spill"
+
 # The exported artifacts must round-trip through the offline tool too:
 # trace_summary renders per-request timelines + the SLO grade from the
 # same files the gate just validated in-process, and --compare diffs

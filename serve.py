@@ -179,6 +179,19 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--shed-watermark", type=int, default=None,
                    help="fleet mode: shed new requests once the fleet-wide "
                         "queue depth reaches this watermark")
+    p.add_argument("--isolation", choices=("thread", "process"),
+                   default="thread",
+                   help="fleet replica isolation (with --replicas > 1): "
+                        "'thread' = in-process engine replicas (default, "
+                        "back-compat); 'process' = each replica is a "
+                        "spawned worker subprocess behind the mingpt-rpc/1 "
+                        "socket surface, SIGKILL-able and independently "
+                        "requeued (exit 75) on drain")
+    p.add_argument("--spill-dir", default=None,
+                   help="process isolation: root directory for per-worker "
+                        "spill state (spec.json, stderr.log, flight dumps "
+                        "collected by the supervisor on process death); "
+                        "default: a temp directory")
     p.add_argument("--chaos-spec", default=None,
                    help="deterministic serving fault spec, e.g. "
                         "'crash:nth=6:match=replica0;slow:every=1:"
@@ -218,6 +231,14 @@ def build_argparser() -> argparse.ArgumentParser:
                         "injected crash + slow faults; verifies greedy "
                         "parity, zero duplicate tokens and fleet metrics, "
                         "then exits")
+    p.add_argument("--selftest-procfleet", action="store_true",
+                   help="ISSUE 16 gate: 2 real replica subprocesses behind "
+                        "the mingpt-rpc/1 socket surface; kill -9 one "
+                        "mid-decode and verify crash-retry parity with "
+                        "zero duplicate tokens, then drain-with-migration "
+                        "and verify the migrated streams are bit-identical "
+                        "with mingpt-trace/1 timelines spanning both "
+                        "replicas; then exits")
     p.add_argument("--selftest-attrib", action="store_true",
                    help="ISSUE 13 gate: per-program attribution ledger "
                         "(prefill/decode/verify/draft/train families with "
@@ -1514,8 +1535,314 @@ def selftest_sharded(args) -> int:
     return rc
 
 
+def selftest_procfleet(args) -> int:
+    """The ISSUE 16 acceptance gate, against REAL subprocesses: two
+    replica workers behind the mingpt-rpc/1 socket surface.
+
+    Phase A — ``kill -9`` one worker mid-decode: every request must
+    finish on the survivor (and the respawned worker) with greedy output
+    token-identical to solo generate() and a caller-visible stream with
+    zero duplicate or lost tokens; the supervisor must have reaped exit
+    code -9 and collected the dead worker's flight spill.
+
+    Phase B — drain-with-migration: the source ships its KV/prefix state
+    to the peer and retires with exit 75 (the requeue contract, now per
+    replica process); every in-flight request completes bit-identical to
+    an undisturbed run, and its strict-validated mingpt-trace/1 timeline
+    spans both replicas (emits on the source, a migrate event, emits on
+    the destination).
+
+    Phase C — the chunked /rpc/stream endpoint replays one request's
+    token stream over the real socket, byte-equal to the handle."""
+    import signal
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import generate as gen
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import (
+        ProcRouter,
+        ProcessSupervisor,
+        Request,
+        WallClock,
+        process_backend_factory,
+    )
+    from mingpt_distributed_tpu.telemetry import parse_prometheus
+    from mingpt_distributed_tpu.telemetry.tracing import (
+        TRACE_SCHEMA,
+        TraceRecorder,
+        validate_trace_records,
+    )
+
+    cfg_kw = dict(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    cfg = GPTConfig.make(**cfg_kw)
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's",
+              "Now is the winter", "Friends, Romans", "To be, or not"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 12
+
+    def solo(p, n):
+        out = gen.generate(params, cfg, jnp.asarray(p, jnp.int32)[None], n)
+        return np.asarray(out)[0, len(p):].tolist()
+
+    class _ListSink:
+        def __init__(self):
+            self.records = []
+
+        def write(self, kind, rec):
+            self.records.append({"schema": TRACE_SCHEMA, "kind": kind,
+                                 **rec})
+
+        def close(self):
+            pass
+
+    spill_root = args.spill_dir or tempfile.mkdtemp(prefix="procfleet-")
+    spec = {
+        "cfg": cfg_kw,
+        "init_seed": 0,
+        "server": {"n_slots": 2, "prefill_chunk": 8,
+                   "prefix_cache_mb": 4.0},
+    }
+    sink = _ListSink()
+    recorder = TraceRecorder(sink=sink)
+    supervisor = ProcessSupervisor(
+        process_backend_factory(spec, spill_root, rpc_timeout_s=120.0),
+        n_replicas=2,
+        clock=WallClock(),
+        max_restarts=1,
+        restart_backoff_s=0.05,
+    )
+    streamed = {}
+
+    def on_token(fh, tok):
+        streamed.setdefault(fh.request_id, []).append(tok)
+
+    router = ProcRouter(supervisor, on_token=on_token, max_retries=3,
+                        retry_backoff_s=0.01, breaker_reset_s=0.05,
+                        trace_recorder=recorder)
+    pids = {rep.name: rep.backend.pid for rep in supervisor.replicas}
+    print(f"selftest-procfleet workers: {pids} (spill: {spill_root})")
+    rc = 0
+
+    # -- Phase A: kill -9 mid-decode ----------------------------------
+    handles = [router.submit(Request(prompt=p, max_new_tokens=max_new))
+               for p in prompts]
+
+    def mid_decode_replica():
+        """A ready replica currently decoding a request that has emitted
+        at least one token — killing it re-derives those tokens on the
+        retry, which is exactly what the dedup layer must absorb."""
+        for (name, _), (fh, rh) in router._attempts.items():
+            rep = supervisor.replica_by_name(name)
+            if (rep.state == "ready" and not rh.finished
+                    and len(rh.tokens) >= 1):
+                return rep
+        return None
+
+    victim = None
+    for _ in range(2000):
+        router.step()
+        victim = mid_decode_replica()
+        if victim is not None:
+            break
+    if victim is None:
+        print("selftest-procfleet FAIL: no replica ever mid-decode")
+        return 1
+    os.kill(victim.backend.pid, signal.SIGKILL)
+    print(f"selftest-procfleet kill -9 {victim.name} "
+          f"(pid {victim.backend.pid}) mid-decode")
+    router.run_until_drained(max_steps=20000)
+    for _ in range(2000):
+        # the restart backoff is wall-time; idle-step until poll_restarts
+        # respawns the victim (phase B needs both replicas up)
+        if supervisor.replica_by_name(victim.name).state == "ready":
+            break
+        router.step()
+
+    for text, p, h in zip(canned, prompts, handles):
+        want = solo(p, max_new)
+        ok = h.finish_reason == "length" and h.tokens == want
+        seen = streamed.get(h.request_id, [])
+        if seen != h.tokens:
+            print(f"selftest-procfleet FAIL {h.request_id}: streamed "
+                  f"{seen} != handle {h.tokens} (duplicate or lost "
+                  f"emission)")
+            rc = 1
+        print(f"selftest-procfleet {h.request_id} ({text!r}): "
+              f"attempts={h.attempts} replica={h.replica} "
+              + ("OK" if ok else f"MISMATCH reason={h.finish_reason} "
+                                 f"fleet={h.tokens} solo={want}"))
+        if not ok:
+            rc = 1
+    summary = router.summary()
+    crash = next((c for c in supervisor.crash_reports
+                  if c["replica"] == victim.name), None)
+    checks_a = [
+        ("crash retries were counted",
+         summary["retries_by_reason"].get("crash", 0) >= 1),
+        ("re-derived tokens were suppressed, not double-streamed",
+         summary["duplicates_suppressed"] >= 1),
+        ("supervisor reaped exit code -9",
+         crash is not None and crash["exit_code"] == -signal.SIGKILL),
+        ("dead worker's flight spill was collected",
+         crash is not None and len(crash["spill_dumps"]) >= 1),
+        ("killed worker was respawned as a new process",
+         supervisor.replica_by_name(victim.name).state == "ready"
+         and supervisor.replica_by_name(victim.name).backend.pid
+         != pids[victim.name]),
+    ]
+    for what, ok in checks_a:
+        if not ok:
+            print(f"selftest-procfleet FAIL (phase A): {what}")
+            rc = 1
+
+    # -- Phase B: drain-with-migration --------------------------------
+    handles_b = [router.submit(Request(prompt=p, max_new_tokens=max_new))
+                 for p in prompts]
+    src = None
+    for _ in range(2000):
+        router.step()
+        src = mid_decode_replica()
+        if src is not None:
+            break
+    if src is None:
+        print("selftest-procfleet FAIL: phase B never reached mid-decode")
+        return 1
+    report = router.migrate_and_drain(src.name)
+    print(f"selftest-procfleet migration: {json.dumps(report)}")
+    router.run_until_drained(max_steps=20000)
+    for text, p, h in zip(canned, prompts, handles_b):
+        want = solo(p, max_new)
+        ok = (h.finish_reason == "length" and h.tokens == want
+              and streamed.get(h.request_id, []) == h.tokens)
+        if not ok:
+            print(f"selftest-procfleet FAIL (phase B) {h.request_id} "
+                  f"({text!r}): reason={h.finish_reason} "
+                  f"fleet={h.tokens} solo={want}")
+            rc = 1
+    moved = set(report["requests_moved"])
+    spanning = 0
+    for h in handles_b:
+        if h.request_id not in moved:
+            continue
+        events = [r for r in sink.records
+                  if r["kind"] == "event" and r["trace_id"] == h.request_id]
+        migrates = [e for e in events if e["name"] == "migrate"]
+        emit_replicas = {e["replica"] for e in events
+                        if e["name"] == "emit"}
+        if not migrates:
+            print(f"selftest-procfleet FAIL: migrated {h.request_id} has "
+                  f"no migrate event")
+            rc = 1
+        if len(emit_replicas) > 1:
+            spanning += 1
+    checks_b = [
+        ("migration shipped state (outcome=ok)",
+         report["outcome"] == "ok"),
+        ("drained worker exited with the requeue code (75)",
+         report["src_exit_code"] == 75),
+        ("prefix/KV entries were installed on the peer",
+         report["entries_installed"] >= 1),
+        ("at least one in-flight request was migrated",
+         len(moved) >= 1),
+        ("a migrated request's timeline spans both replicas",
+         spanning >= 1),
+    ]
+    for what, ok in checks_b:
+        if not ok:
+            print(f"selftest-procfleet FAIL (phase B): {what}")
+            rc = 1
+
+    # -- Phase C: chunked token stream over the real socket -----------
+    h = router.submit(Request(prompt=prompts[0], max_new_tokens=max_new))
+    router.step()
+    attempt = next(((name, aid) for (name, aid), (fh, _)
+                    in router._attempts.items()
+                    if fh.request_id == h.request_id), None)
+    if attempt is None:
+        print("selftest-procfleet FAIL: phase C request not in flight")
+        rc = 1
+    else:
+        name, aid = attempt
+        transport = supervisor.replica_by_name(name).backend.transport
+        got = []
+
+        def consume():
+            for doc in transport.stream(f"/rpc/stream?request_id={aid}"):
+                got.append(doc)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        router.run_until_drained(max_steps=20000)
+        t.join(timeout=60.0)
+        toks = [d["token"] for d in got if d["kind"] == "stream_token"]
+        ends = [d for d in got if d["kind"] == "stream_end"]
+        if t.is_alive() or toks != h.tokens or not ends \
+                or ends[0]["finish_reason"] != "length":
+            print(f"selftest-procfleet FAIL (phase C): stream endpoint "
+                  f"gave tokens={toks} ends={ends} vs handle={h.tokens}")
+            rc = 1
+
+    # -- fleet observability over the socket --------------------------
+    page = router.fleet_metrics_page()
+    parsed = parse_prometheus(page)  # strict: one TYPE line per family
+    by_name = {}
+    for sname, labels, value in parsed["samples"]:
+        by_name.setdefault(sname, []).append((labels, value))
+    migr_ok = any(labels.get("outcome") == "ok" and value >= 1
+                  for labels, value in
+                  by_name.get("mingpt_fleet_migrations_total", []))
+    restarts_ok = any(value >= 1 for _, value in
+                      by_name.get("mingpt_fleet_process_restarts_total",
+                                  []))
+    replica_labelled = any("replica" in labels for labels, _ in
+                           by_name.get("mingpt_serve_steps_total", []))
+    for what, ok in [
+        ("merged page counts the migration", migr_ok),
+        ("merged page counts the process restart", restarts_ok),
+        ("worker pages merged under the replica label",
+         replica_labelled),
+    ]:
+        if not ok:
+            print(f"selftest-procfleet FAIL: {what}")
+            rc = 1
+
+    recorder.close()
+    if recorder.active_traces:
+        print(f"selftest-procfleet FAIL: {recorder.active_traces} "
+              f"trace(s) still open")
+        rc = 1
+    try:
+        validate_trace_records(sink.records)
+    except ValueError as e:
+        print(f"selftest-procfleet FAIL: trace validation: {e}")
+        rc = 1
+
+    exits = supervisor.shutdown_all()
+    bad_exits = {n: c for n, c in exits.items()
+                 if c not in (75, -signal.SIGKILL)}
+    if bad_exits:
+        print(f"selftest-procfleet FAIL: unexpected worker exit codes "
+              f"{bad_exits} (want 75 for drained, -9 for killed)")
+        rc = 1
+    print(f"selftest-procfleet worker exits: {exits}")
+    print("selftest-procfleet", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
+    if args.selftest_procfleet:
+        return selftest_procfleet(args)
     if args.selftest_sharded:
         return selftest_sharded(args)
     if args.selftest_attrib:
@@ -1580,8 +1907,60 @@ def main(argv=None) -> int:
 
     def build_backend(stream_cb):
         """One InferenceServer by default; --replicas N puts the fleet
-        router in front of N supervised replicas. Both expose submit /
+        router in front of N supervised replicas (--isolation process
+        moves each replica into its own subprocess behind the
+        mingpt-rpc/1 socket surface). All expose submit /
         run_until_drained / summary with the same handle surface."""
+        if args.isolation == "process":
+            import tempfile
+
+            from mingpt_distributed_tpu.serving import (
+                ProcRouter,
+                ProcessSupervisor,
+                WallClock,
+                process_backend_factory,
+            )
+            from mingpt_distributed_tpu.training.faults import (
+                ProcessFaultInjector,
+            )
+            cfg_doc = dataclasses.asdict(gpt_cfg)
+            if cfg_doc.get("n_layer") is not None:
+                # make() wants model_type XOR explicit dims; asdict
+                # carries both once a preset has been resolved
+                cfg_doc.pop("model_type", None)
+            spec = {
+                "cfg": cfg_doc,
+                "snapshot": path,  # workers restore the trained params
+                "server": {"n_slots": args.slots,
+                           "max_queue": args.queue_limit,
+                           "default_deadline_s": args.deadline_s,
+                           "attrib": bool(args.attrib_json),
+                           **_server_kwargs(args)},
+                "serving_faults": args.chaos_spec,
+            }
+            spill_root = args.spill_dir or tempfile.mkdtemp(
+                prefix="procfleet-")
+            # process-level faults (kill/hang/slow_socket) come from
+            # MINGPT_PROCESS_FAULTS; serving faults ride in the spec
+            pinj = ProcessFaultInjector()
+            supervisor = ProcessSupervisor(
+                process_backend_factory(spec, spill_root),
+                n_replicas=max(1, args.replicas),
+                clock=WallClock(),
+                process_injector=pinj if pinj.specs else None,
+                registry=reg,
+            )
+            router = ProcRouter(supervisor, on_token=stream_cb,
+                                shed_watermark=args.shed_watermark,
+                                trace_recorder=recorder, flight=flight)
+            if tserver is not None:
+                tserver.health_provider = router.health_report
+                # fleet scrape over RPC: worker /metrics pages merged
+                # under the replica label
+                tserver.metrics_provider = router.fleet_metrics_page
+                if args.attrib_json:
+                    tserver.attrib_provider = router.attrib_report
+            return router
         if args.replicas > 1:
             from mingpt_distributed_tpu.serving import (
                 ReplicaSupervisor,

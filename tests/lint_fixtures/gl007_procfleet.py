@@ -1,0 +1,47 @@
+"""GL007 fixtures — wall-clock shapes at the procfleet RPC boundary.
+
+Positives: a wall sleep in a respawn backoff; ``time.monotonic()``
+deciding an RPC deadline.
+Suppressed: one perf_counter read, inline disable.
+Negatives: the procfleet-approved shapes — socket timeouts are
+connection attributes (the OS enforces them; no ``time.*`` call), step
+deadlines read an injected clock, slow-socket faults land as clock skew
+rather than a sleep, and an injectable-sleep default argument is a
+*reference*, not a call (the ``RetryPolicy.sleep`` idiom
+``ProcessFaultInjector`` reuses).
+"""
+import socket
+import time
+
+
+def respawn_backoff_bad(used):
+    time.sleep(0.05 * 2 ** used)  # expect: GL007
+
+
+def rpc_deadline_bad(timeout_s):
+    return time.monotonic() + timeout_s  # expect: GL007
+
+
+def handshake_latency_suppressed():
+    return time.perf_counter()  # graftlint: disable=GL007
+
+
+def connect_with_timeout(host, port, timeout_s):
+    # clean: a socket timeout is a connection attribute — nobody reads
+    # or advances a clock here, the kernel does the timing
+    conn = socket.create_connection((host, port), timeout=timeout_s)
+    conn.settimeout(timeout_s * 4)
+    return conn
+
+
+def step_deadline(clock, timeout_s):
+    return clock() + timeout_s  # clean: injected clock
+
+
+def slow_socket_fault(clock, skew_s):
+    clock.skew_s += skew_s  # clean: fault lands as skew, never a sleep
+    return clock.skew_s
+
+
+def injectable_rpc_retry(sleep=time.sleep):  # clean: reference, not call
+    return sleep

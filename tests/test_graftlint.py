@@ -331,6 +331,35 @@ def test_trafficlab_package_clean_under_clock_rule():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_procfleet_package_clean_under_clock_rule():
+    """ISSUE 16: the procfleet chaos suite is sleep-free and
+    byte-deterministic only because process-level faults (kill, hang,
+    slow_socket) land as raised verdicts or clock skew on the injected
+    clock — a wall sleep in the supervisor's respawn backoff or a
+    ``time.monotonic()`` in an RPC deadline would silently turn the
+    loopback chaos tests into wall-time tests. The whole package has an
+    explicit GL007 scope entry (Config.clock_paths) and must be
+    clock-clean outright — no suppressions, no baseline entries; socket
+    timeouts stay allowed because they are connection attributes, not
+    ``time.*`` calls. The hazard and approved shapes are pinned by the
+    gl007_procfleet.py fixture."""
+    pkg = os.path.join(
+        REPO, "mingpt_distributed_tpu", "serving", "procfleet")
+    paths = sorted(
+        os.path.join(pkg, f) for f in os.listdir(pkg) if f.endswith(".py"))
+    assert len(paths) >= 5  # __init__, rpc, transport, worker, supervisor
+    cfg = Engine(select=["GL007"], root=REPO).config
+    # pinned explicitly, not only via the serving/ prefix: narrowing
+    # serving/ later must not silently drop procfleet from scope
+    assert "serving/procfleet/" in cfg.clock_paths
+    for p in paths:
+        rel = os.path.relpath(p, REPO)
+        assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL007"], root=REPO).run(paths)
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_attribution_module_clean_under_clock_and_name_rules():
     """ISSUE 13: the attribution ledger's byte-identical-report
     guarantee (two VirtualClock serving runs must dump the same
