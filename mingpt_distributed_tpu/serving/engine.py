@@ -484,6 +484,29 @@ class DecodeEngine:
         lane = self._extract_jit(self.pool.cache, np.int32(slot), rows=rows)
         return lane["k"], lane["v"]
 
+    def install_slot_rows(self, slot: int, k, v) -> int:
+        """Copy an extracted (L, 1, rows, KV, hd) K/V entry straight into
+        ``slot``'s leading cache rows — the install half of live
+        migration for engines that have no prefix store (the draft
+        engine): same compiled row-copy program ``try_load_prefix``
+        uses, re-placed under the pool's sharding first so adopted rows
+        stay head-sharded. Returns the rows installed."""
+        rows = int(k.shape[2])
+        if rows not in self.buckets:
+            raise ValueError(
+                f"install rows {rows} not on the bucket ladder "
+                f"{self.buckets} — migration must reuse the compiled "
+                f"prefix-copy programs, not mint new ones")
+        if self.kv_sharding is not None:
+            k = jax.device_put(k, self.kv_sharding)
+            v = jax.device_put(v, self.kv_sharding)
+        else:
+            k = jnp.asarray(k)
+            v = jnp.asarray(v)
+        self.pool.cache = self._install_jit(
+            self.pool.cache, k, v, np.int32(slot))
+        return rows
+
     def adopt_prefix_entry(self, key: Sequence[int], k, v) -> bool:
         """Install a migrated prefix entry (host arrays off the transfer
         channel) into THIS engine's prefix store, re-placed under the

@@ -271,6 +271,8 @@ class Replica:
         self.itl_slo_s = itl_slo_s
         self.state = "ready"          # "ready" | "crashed"
         self.crashes = 0
+        self.crashed_at: Optional[float] = None  # fleet-clock crash time
+        self.last_spawn_path = "cold"            # "cold" | "standby"
         self.server: InferenceServer = self._spawn()
 
     def _spawn(self) -> InferenceServer:
@@ -376,6 +378,12 @@ class ReplicaSupervisor:
             "mingpt_fleet_restarts_total",
             help="fresh servers spawned to replace crashed ones",
             labels=("replica",))
+        self._recovery = r.histogram(
+            "mingpt_fleet_recovery_seconds",
+            help="crash -> replacement-serving time per respawn, by "
+                 "path: cold = spawn + restore + compile, standby = "
+                 "adopt a pre-warmed spare (ISSUE 17)",
+            labels=("path",))
         for rep in self.replicas:
             self._up.labels(replica=rep.name).set(1)
             self._healthy.labels(replica=rep.name).set(1)
@@ -383,6 +391,11 @@ class ReplicaSupervisor:
             self._restarts.labels(replica=rep.name).inc(0)
         self._restart_due: Dict[str, float] = {}
         self._restarts_used: Dict[str, int] = {}
+        #: respawn post-mortems in crash order: {replica, path,
+        #: recovery_s, adopted} — the chaos gates compare cold vs
+        #: standby recovery on these recorded numbers
+        self.recovery_log: List[Dict] = []
+        self.last_recovery: Dict[str, Dict] = {}
 
     def replica_by_name(self, name: str) -> Optional[Replica]:
         for rep in self.replicas:
@@ -393,6 +406,7 @@ class ReplicaSupervisor:
     def mark_crashed(self, replica: Replica) -> None:
         replica.state = "crashed"
         replica.crashes += 1
+        replica.crashed_at = self.clock.now()
         self._crashes.labels(replica=replica.name).inc()
         self._up.labels(replica=replica.name).set(0)
         used = self._restarts_used.get(replica.name, 0)
@@ -418,8 +432,33 @@ class ReplicaSupervisor:
             rep.respawn()
             self._restarts.labels(replica=name).inc()
             self._up.labels(replica=name).set(1)
+            if rep.crashed_at is not None:
+                rec_s = max(0.0, self.clock.now() - rep.crashed_at)
+                path = rep.last_spawn_path
+                self._recovery.labels(path=path).observe(rec_s)
+                info = {"replica": name, "path": path,
+                        "recovery_s": rec_s,
+                        "adopted": getattr(rep, "adopted_name", None)}
+                self.recovery_log.append(info)
+                self.last_recovery[name] = info
+                rep.crashed_at = None
             restarted.append(rep)
         return restarted
+
+    def poll_liveness(self) -> List[Tuple[str, str]]:
+        """Hang-escalation hook: (replica, signal) pairs escalated this
+        poll. The in-process fleet has no process to signal — a hung
+        thread replica cannot exist on the cooperative scheduler — so
+        the base supervisor never escalates; procfleet's
+        ProcessSupervisor overrides this with the SIGTERM→SIGKILL
+        liveness ladder."""
+        return []
+
+    def recovery_info(self, name: str) -> Optional[Dict]:
+        """The most recent respawn post-mortem for ``name`` (None before
+        its first recovery) — the router stamps ``failover`` trace
+        events from this."""
+        return self.last_recovery.get(name)
 
     def refresh_health_gauges(self) -> None:
         for rep in self.replicas:
@@ -466,6 +505,8 @@ class FleetHandle:
     replica: Optional[str] = None        # current / last placement
     duplicates_suppressed: int = 0       # re-emitted token indices dropped
     trace: Optional[TraceContext] = None  # root trace context (ISSUE 10)
+    fault_at: Optional[float] = None     # fleet clock when a fault hit us
+    recovery_s: Optional[float] = None   # fault -> first NEW token after it
 
 
 class Router:
@@ -606,6 +647,12 @@ class Router:
                 self._dup_suppressed.inc()
                 return
             fh.tokens.append(token)
+            if fh.fault_at is not None:
+                # first NEW caller-visible token since a fault hit this
+                # request: the recovery tail the chaos sweeps grade
+                # (recovery_pNN pools this per-request scalar)
+                fh.recovery_s = self.clock.now() - fh.fault_at
+                fh.fault_at = None
             # emit events on the FLEET clock, dedup-aware: only tokens
             # that actually reach the caller become events, so a trace's
             # emit count always equals the visible token count
@@ -801,11 +848,15 @@ class Router:
         outcome = "completed" if reason in ("length", "eos") else reason
         self._requests_total.labels(outcome=outcome).inc()
         if self.trace_recorder is not None and fh.trace is not None:
+            attrs = {"replica": fh.replica,
+                     "duplicates_suppressed": fh.duplicates_suppressed}
+            if fh.recovery_s is not None:
+                # only fault-touched requests carry the scalar, so an
+                # undisturbed run's summaries stay byte-identical
+                attrs["recovery_s"] = fh.recovery_s
             self.trace_recorder.end_trace(
                 fh.trace, now=self.clock.now(), outcome=reason,
-                n_tokens=len(fh.tokens), attempts=fh.attempts,
-                replica=fh.replica,
-                duplicates_suppressed=fh.duplicates_suppressed)
+                n_tokens=len(fh.tokens), attempts=fh.attempts, **attrs)
 
     def _retry_or_fail(self, fh: FleetHandle, reason: str) -> None:
         if fh.attempts > self.max_retries:
@@ -860,6 +911,7 @@ class Router:
                 self._resolve_finished(rep.name, fh, rh, crashed=True)
             elif not fh.finished:
                 fh.error = exc
+                fh.fault_at = self.clock.now()
                 self._close_attempt_span(fh, rh, "crash")
                 victims.append(fh)
         for fh in victims:
@@ -888,9 +940,30 @@ class Router:
         reconcile outcomes → gauges → clock tick. Returns True while any
         routed request is unfinished."""
         now = self.clock.now()
+        for name, signal in self.supervisor.poll_liveness():
+            # the ladder only SIGNALS the stuck process here; the death
+            # is observed — and its requests re-routed — through the
+            # ordinary crash path on the next step RPC
+            if self.flight is not None:
+                self.flight.dump("hang_escalation", replica=name,
+                                 signal=signal)
         for rep in self.supervisor.poll_restarts():
             self._wire_replica(rep)
             self.breakers[rep.name].reset_to_probe()
+            info = self.supervisor.recovery_info(rep.name)
+            if info is not None and self.trace_recorder is not None:
+                # failover event spanning dead replica -> its
+                # replacement, on every in-flight request the crash
+                # re-routed (their retries are still pending here)
+                for fh, _ in self._pending:
+                    if (fh.replica == rep.name and not fh.finished
+                            and fh.trace is not None):
+                        self.trace_recorder.add_event(
+                            fh.trace, "failover", now,
+                            from_replica=rep.name,
+                            to_replica=info.get("adopted") or rep.name,
+                            path=info["path"],
+                            recovery_s=info["recovery_s"])
 
         if (self._pending
                 and not self.supervisor.ready_replicas()

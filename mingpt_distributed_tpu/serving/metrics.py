@@ -157,6 +157,13 @@ class ServingMetrics:
         self._spec_accept_rate = r.gauge(
             "mingpt_serve_spec_accept_rate",
             help="cumulative accepted/proposed draft tokens")
+        self._spec_prime = r.counter(
+            "mingpt_serve_spec_prime_total",
+            help="draft primes by path: full = paid a draft prefill, "
+                 "adopted = resumed from migrated draft rows (ISSUE 17)",
+            labels=("mode",))
+        for mode in ("full", "adopted"):
+            self._spec_prime.labels(mode=mode).inc(0)
         # gauges sampled at step boundaries
         self._queue_depth = r.gauge(
             "mingpt_serve_queue_depth", help="queued requests after the "
@@ -301,6 +308,11 @@ class ServingMetrics:
 
     def on_tokens(self, n: int) -> None:
         self._tokens.inc(n)
+
+    def on_spec_prime(self, mode: str) -> None:
+        """One draft prime: ``mode`` is ``"full"`` (paid a prefill) or
+        ``"adopted"`` (resumed from migrated draft rows)."""
+        self._spec_prime.labels(mode=mode).inc()
 
     def on_spec_round(self, proposed: int, emitted: int) -> None:
         """One verify round on one slot: ``proposed`` = k draft tokens

@@ -87,7 +87,8 @@ _KIND_FIELDS: Dict[str, Dict[str, Any]] = {
     "drain_result": {"draining": bool, "unfinished": int},
     "health": {"queue_depth": int, "occupied": int, "draining": bool,
                "recompiles": int, "pid": int},
-    "migrate_in_result": {"installed": int, "skipped": int},
+    "migrate_in_result": {"installed": int, "skipped": int,
+                          "draft_installed": int},
     "stream_token": {"request_id": str, "token": int, "token_index": int},
     "stream_end": {"request_id": str, "finish_reason": str},
     "error": {"error": str, "message": str},

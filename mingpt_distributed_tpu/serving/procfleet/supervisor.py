@@ -37,6 +37,31 @@ rewriting any of that machinery:
   copy. The drained process exits ``REQUEUE_EXIT_CODE`` (75): the
   scheduler-requeue contract now holds per replica process.
 
+Warm-standby failover (ISSUE 17 tentpole) layers three mechanisms on
+top of that machinery without changing its shape:
+
+* :class:`StandbyPool` — N spare workers kept *fully spawned* (params
+  restored, program family warmed at worker startup) behind the same
+  backend factory. ``ProcReplica._spawn`` adopts a hot spare instead of
+  paying spawn + restore + compile, the supervisor collapses the
+  restart backoff to "next round" when a spare is waiting, and the
+  pool backfills after adoption — off the recovery critical path.
+
+* a supervision escalation ladder — :meth:`ProcessSupervisor.
+  poll_liveness` watches per-replica step progress on the injected
+  clock; a replica that holds work but completes no round for
+  ``hang_deadline_s`` gets SIGTERM, and SIGKILL ``hang_kill_grace_s``
+  later if the process is still alive (a worker wedged inside the step
+  RPC ignores SIGTERM, like any GIL-held spin). The death is then
+  observed through the ordinary crash path, so the replacement routes
+  through standby adoption like any other crash.
+
+* speculative-state-complete migration — ``migrate_and_drain`` already
+  ships prefix/KV rows; the worker's migrate framing now also carries
+  draft-pool rows (head-sharded under tp, lockstep slot mirroring on
+  the peer), so a migrated speculative request resumes *proposing*
+  without a draft re-prefill (see ``worker.migrate_out_frames``).
+
 Nothing in this module reads the wall clock: fleet time is the injected
 clock, process liveness is ``waitpid``, and socket timeouts (an OS I/O
 deadline, not a ``time.*`` call) bound real-transport RPCs.
@@ -49,7 +74,7 @@ import json
 import os
 import subprocess
 import sys
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from mingpt_distributed_tpu.serving.fleet import (
     REQUEUE_EXIT_CODE,
@@ -57,6 +82,7 @@ from mingpt_distributed_tpu.serving.fleet import (
     ReplicaHealth,
     ReplicaSupervisor,
     Router,
+    SkewedClock,
 )
 from mingpt_distributed_tpu.serving.procfleet.rpc import (
     EnvelopeError,
@@ -72,6 +98,7 @@ from mingpt_distributed_tpu.serving.procfleet.transport import (
 from mingpt_distributed_tpu.serving.requests import QueueFullError
 from mingpt_distributed_tpu.telemetry import (
     MetricsRegistry,
+    log_event,
     merge_fleet_pages,
     render_prometheus,
 )
@@ -79,6 +106,7 @@ from mingpt_distributed_tpu.training.faults import (
     InjectedAdmissionError,
     ProcessFaultInjector,
     ProcessKilled,
+    WorkerStuck,
 )
 
 __all__ = [
@@ -88,6 +116,7 @@ __all__ = [
     "ProcessSupervisor",
     "ReplicaUnreachable",
     "ServerProxy",
+    "StandbyPool",
     "LoopbackBackend",
     "loopback_backend_factory",
     "process_backend_factory",
@@ -281,10 +310,18 @@ class LoopbackBackend:
         self.transport = LoopbackTransport(worker)
         self.spill_dir = spill_dir
         self.attrib_enabled = attrib_enabled
+        self.wedged = False
         self._exit_code: Optional[int] = None
 
     def alive(self) -> bool:
         return self._exit_code is None
+
+    def mark_wedged(self) -> None:
+        """The worker is stuck inside the step RPC. A real wedged worker
+        holds the GIL in its signal-handling thread's stead, so SIGTERM's
+        Python-level handler never runs — emulate that: only SIGKILL
+        (which the OS delivers regardless) clears a wedged loopback."""
+        self.wedged = True
 
     def sigkill(self) -> None:
         if self._exit_code is None:
@@ -292,6 +329,8 @@ class LoopbackBackend:
             self.transport.close()
 
     def sigterm(self) -> None:
+        if self.wedged:
+            return
         if self._exit_code is None:
             if self.worker.flight is not None:
                 self.worker.flight.dump(
@@ -330,6 +369,12 @@ class ProcessBackend:
 
     def alive(self) -> bool:
         return self.proc.poll() is None
+
+    def mark_wedged(self) -> None:
+        """No-op: a real subprocess wedges worker-side (the worker's own
+        injector blocks the step RPC and its SIGTERM handler refuses to
+        exit while wedged) — the OS, not this object, decides what
+        signals do."""
 
     def sigkill(self) -> None:
         if self.alive():
@@ -444,6 +489,94 @@ def process_backend_factory(spec_base: Dict[str, Any], spill_root: str,
 
 
 # ---------------------------------------------------------------------
+# StandbyPool
+# ---------------------------------------------------------------------
+
+class StandbyPool:
+    """N spare workers kept fully spawned behind the same backend
+    factory the replicas use — params restored and the program family
+    warmed at worker startup, so adoption is a pointer swap plus a
+    health probe instead of spawn + restore + compile.
+
+    Each spare owns its :class:`~.fleet.SkewedClock` over the fleet
+    clock; the adopting replica takes the clock along with the backend
+    (the spare's server was built against it). Spares carry no
+    serving-fault hook: round hooks close over a *replica* name, and a
+    spare has none until adopted — process-level faults still apply,
+    they key on the adopting replica's name at the RPC seam.
+
+    ``fill()`` is synchronous and is called from ``poll_restarts`` —
+    AFTER the adoption that emptied the slot — so backfill cost never
+    sits on the recovery critical path.
+    """
+
+    def __init__(self, factory, fleet_clock, size: int,
+                 registry: MetricsRegistry, name_prefix: str = "standby"):
+        if size < 1:
+            raise ValueError(f"standby pool size must be >= 1, got {size}")
+        self.factory = factory
+        self.fleet_clock = fleet_clock
+        self.size = size
+        self.name_prefix = name_prefix
+        self._spares: List[Tuple[str, Any, SkewedClock]] = []
+        self._spawned = 0
+        self._gauge = registry.gauge(
+            "mingpt_fleet_standby_pool_size",
+            help="pre-warmed spare workers currently available for "
+                 "adoption (dips on adoption, restored by backfill)")
+        self._adoptions = registry.counter(
+            "mingpt_fleet_standby_adoptions_total",
+            help="crashed replicas recovered by adopting a hot spare "
+                 "instead of a cold respawn")
+        self._gauge.set(0)
+        self._adoptions.inc(0)
+        self.fill()
+
+    def available(self) -> int:
+        return len(self._spares)
+
+    def fill(self) -> int:
+        """Spawn spares until the pool holds ``size``; returns how many
+        were added."""
+        added = 0
+        while len(self._spares) < self.size:
+            name = f"{self.name_prefix}{self._spawned}"
+            self._spawned += 1
+            clock = SkewedClock(self.fleet_clock.now)
+            backend = self.factory(name=name, clock=clock, fault_hook=None)
+            self._spares.append((name, backend, clock))
+            added += 1
+        self._gauge.set(len(self._spares))
+        return added
+
+    def acquire(self) -> Optional[Tuple[str, Any, SkewedClock]]:
+        """Pop the oldest (warmest) spare, or None when exhausted. Does
+        NOT backfill — the caller is mid-recovery."""
+        while self._spares:
+            name, backend, clock = self._spares.pop(0)
+            self._gauge.set(len(self._spares))
+            if not backend.alive():
+                # a spare that died while idle is not adoptable; skip it
+                backend.transport.close()
+                continue
+            self._adoptions.inc()
+            return name, backend, clock
+        return None
+
+    def shutdown(self) -> None:
+        """Retire every remaining spare (test teardown / end of serving)."""
+        for _, backend, _ in self._spares:
+            if backend.alive():
+                backend.sigterm()
+                if backend.wait(timeout_s=10.0) is None:
+                    backend.sigkill()
+                    backend.wait(timeout_s=10.0)
+            backend.transport.close()
+        self._spares.clear()
+        self._gauge.set(0)
+
+
+# ---------------------------------------------------------------------
 # ProcReplica
 # ---------------------------------------------------------------------
 
@@ -457,12 +590,39 @@ class ProcReplica(Replica):
     backend = None
     pinj: Optional[ProcessFaultInjector] = None
     draining = False
+    #: set by ProcessSupervisor when a warm pool exists; class default
+    #: None means construction-time spawns are always cold
+    standby_pool: Optional[StandbyPool] = None
+    #: spare identity adopted at the last standby-path spawn
+    adopted_name: Optional[str] = None
+    #: successfully completed step rounds — the liveness ladder's
+    #: progress signal (a wedged replica's count stops advancing)
+    steps_ok = 0
 
     def _spawn(self) -> ServerProxy:
-        hook = (self.injector.round_hook(self.name)
-                if self.injector is not None else None)
-        self.backend = self._factory(name=self.name, clock=self.clock,
-                                     fault_hook=hook)
+        adopted = (self.standby_pool.acquire()
+                   if self.standby_pool is not None else None)
+        if adopted is not None:
+            spare_name, backend, clock = adopted
+            self.backend = backend
+            # the spare's server was built against the spare's clock;
+            # adopt the clock with it so skew faults keep one timeline
+            self.clock = clock
+            self.last_spawn_path = "standby"
+            self.adopted_name = spare_name
+        else:
+            if self.standby_pool is not None:
+                # a pool was provisioned but had nothing hot: say so
+                # loudly — the operator sized it for the fault rate
+                log_event(
+                    f"[procfleet] standby pool exhausted: cold respawn "
+                    f"for {self.name}", file=sys.stderr)
+            hook = (self.injector.round_hook(self.name)
+                    if self.injector is not None else None)
+            self.backend = self._factory(name=self.name, clock=self.clock,
+                                         fault_hook=hook)
+            self.last_spawn_path = "cold"
+            self.adopted_name = None
         proxy = ServerProxy(self.backend.transport, self.name,
                             clock=self.clock)
         if self.backend.attrib_enabled:
@@ -476,10 +636,21 @@ class ProcReplica(Replica):
                 old.sigkill()
                 old.wait(timeout_s=10.0)
             old.transport.close()
+        if self.pinj is not None:
+            # a sticky stuck_step wedge belongs to the dead process, not
+            # to the name — the replacement answers its RPCs
+            self.pinj.reset(self.name)
         self.draining = False
         super().respawn()
 
     def step(self) -> bool:
+        if self.backend is not None and self.backend.exit_code() is not None:
+            # the liveness ladder (or the OS) killed the process between
+            # rounds: observe the death BEFORE consulting injectors, or
+            # a sticky wedge would mask the crash forever
+            raise ProcessKilled(
+                f"replica {self.name} process dead before step "
+                f"(exit={self.backend.exit_code()})")
         if self.injector is not None:
             # in-process "slow" faults land as clock skew, same as the
             # thread fleet; crash-grade serving faults fire worker-side
@@ -493,10 +664,16 @@ class ProcReplica(Replica):
                 self.backend.sigkill()
                 self.backend.wait(timeout_s=10.0)
                 raise
+            except WorkerStuck:
+                # the worker wedged inside the step RPC: every later RPC
+                # to it times out too, and SIGTERM's handler never runs.
+                # Only the supervisor's SIGKILL rung clears it.
+                self.backend.mark_wedged()
+                raise
             # InjectedHang propagates: replica alive, round lost — the
             # router's step-failure path records a breaker failure
         try:
-            return self.server.step()
+            busy = self.server.step()
         except TransportTimeout:
             raise  # lost round, process presumed alive
         except TransportError as e:
@@ -504,6 +681,8 @@ class ProcReplica(Replica):
             raise ProcessKilled(
                 f"replica {self.name} process died mid-step "
                 f"(exit={self.backend.exit_code()}): {e}") from e
+        self.steps_ok += 1
+        return busy
 
     def health(self) -> ReplicaHealth:
         if self.state == "drained":
@@ -548,13 +727,26 @@ class ProcReplica(Replica):
 
 class ProcessSupervisor(ReplicaSupervisor):
     """ReplicaSupervisor over ProcReplica: the same backoff/budget
-    lifecycle, plus OS-level crash forensics (exit codes, spill dumps)
-    and the process-restart / migration counters."""
+    lifecycle, plus OS-level crash forensics (exit codes, spill dumps),
+    the process-restart / migration counters, and — when provisioned —
+    the warm-standby pool and the hang-escalation liveness ladder.
+
+    ``standby=N`` keeps N spares hot; a crash whose restart the budget
+    allows is then rescheduled for *now* (adoption needs no backoff —
+    the spare is already serving-ready) and ``poll_restarts`` backfills
+    the pool afterwards. ``hang_deadline_s`` arms the ladder: a replica
+    holding work that completes no round for that long (fleet clock)
+    gets SIGTERM; if the process is still alive ``hang_kill_grace_s``
+    later — a wedged worker ignores SIGTERM — it gets SIGKILL, and the
+    death recovers through the ordinary crash path."""
 
     replica_cls = ProcReplica
 
     def __init__(self, backend_factory, n_replicas: int = 2, clock=None,
                  injector=None, process_injector=None, registry=None,
+                 standby: int = 0,
+                 hang_deadline_s: Optional[float] = None,
+                 hang_kill_grace_s: float = 0.05,
                  **kwargs):
         super().__init__(backend_factory, n_replicas=n_replicas,
                          clock=clock, injector=injector,
@@ -562,6 +754,10 @@ class ProcessSupervisor(ReplicaSupervisor):
         self.process_injector = process_injector
         for rep in self.replicas:
             rep.pinj = process_injector
+        self.hang_deadline_s = hang_deadline_s
+        self.hang_kill_grace_s = hang_kill_grace_s
+        #: replica -> {count, since, term_at}: step progress watermarks
+        self._liveness: Dict[str, Dict[str, Any]] = {}
         r = self.registry
         self._proc_restarts = r.counter(
             "mingpt_fleet_process_restarts_total",
@@ -575,10 +771,24 @@ class ProcessSupervisor(ReplicaSupervisor):
                  "shipped and installed; failed = transfer failed, "
                  "requests still recovered by plain re-route)",
             labels=("outcome",))
+        self._hang_esc = r.counter(
+            "mingpt_fleet_hang_escalations_total",
+            help="stuck-replica escalations by signal: term = polite "
+                 "SIGTERM at the liveness deadline, kill = SIGKILL after "
+                 "the grace window with the process still alive",
+            labels=("signal",))
         for rep in self.replicas:
             self._proc_restarts.labels(replica=rep.name).inc(0)
         for outcome in ("ok", "failed"):
             self._migrations.labels(outcome=outcome).inc(0)
+        for sig in ("term", "kill"):
+            self._hang_esc.labels(signal=sig).inc(0)
+        self.standby_pool: Optional[StandbyPool] = None
+        if standby > 0:
+            self.standby_pool = StandbyPool(
+                backend_factory, self.clock, standby, r)
+            for rep in self.replicas:
+                rep.standby_pool = self.standby_pool
         #: post-mortems collected at mark_crashed time, in crash order
         self.crash_reports: List[Dict[str, Any]] = []
         #: replica name -> exit code recorded at graceful retirement
@@ -586,6 +796,14 @@ class ProcessSupervisor(ReplicaSupervisor):
 
     def mark_crashed(self, replica) -> None:
         super().mark_crashed(replica)
+        self._liveness.pop(replica.name, None)
+        if (self.standby_pool is not None
+                and self.standby_pool.available() > 0
+                and replica.name in self._restart_due):
+            # a hot spare is waiting: adoption needs no cold-spawn
+            # backoff, so the replacement serves on the next round (the
+            # restart *budget* still applies — the base scheduled this)
+            self._restart_due[replica.name] = self.clock.now()
         self.crash_reports.append(
             {"replica": replica.name, **replica.reap()})
 
@@ -593,7 +811,50 @@ class ProcessSupervisor(ReplicaSupervisor):
         restarted = super().poll_restarts()
         for rep in restarted:
             self._proc_restarts.labels(replica=rep.name).inc()
+        if restarted and self.standby_pool is not None:
+            # backfill AFTER the adoptions above — the spawn cost lands
+            # here, not on the crash->serving window just recorded
+            self.standby_pool.fill()
         return restarted
+
+    def poll_liveness(self) -> List[Tuple[str, str]]:
+        """The escalation ladder, driven once per router round on the
+        injected clock. Progress = ``steps_ok`` advancing; only replicas
+        that hold work are judged (the router does not step idle
+        replicas, so an idle stall is not a hang). Returns the
+        ``(replica, signal)`` escalations fired this poll."""
+        escalated: List[Tuple[str, str]] = []
+        if self.hang_deadline_s is None:
+            return escalated
+        now = self.clock.now()
+        for rep in self.replicas:
+            if (rep.state != "ready" or rep.backend is None
+                    or rep.load == 0):
+                self._liveness.pop(rep.name, None)
+                continue
+            if rep.backend.exit_code() is not None:
+                continue  # already dead; the crash path observes it next
+            st = self._liveness.get(rep.name)
+            if st is None or rep.steps_ok != st["count"]:
+                self._liveness[rep.name] = {
+                    "count": rep.steps_ok, "since": now, "term_at": None}
+                continue
+            if st["term_at"] is None:
+                if now - st["since"] >= self.hang_deadline_s:
+                    rep.backend.sigterm()
+                    st["term_at"] = now
+                    self._hang_esc.labels(signal="term").inc()
+                    escalated.append((rep.name, "term"))
+            elif now - st["term_at"] >= self.hang_kill_grace_s:
+                # grace expired with the process still alive: the worker
+                # ignored SIGTERM (wedged inside the step RPC) — SIGKILL
+                # is not ignorable
+                rep.backend.sigkill()
+                rep.backend.wait(timeout_s=10.0)
+                self._hang_esc.labels(signal="kill").inc()
+                escalated.append((rep.name, "kill"))
+                self._liveness.pop(rep.name, None)
+        return escalated
 
     def retire_replica(self, replica) -> Dict[str, Any]:
         """Graceful, terminal shutdown (post-migration): the replica
@@ -608,13 +869,16 @@ class ProcessSupervisor(ReplicaSupervisor):
         return info
 
     def shutdown_all(self) -> Dict[str, Optional[int]]:
-        """Terminate every live backend (end of serving / test teardown)."""
+        """Terminate every live backend — replicas AND unadopted spares
+        (end of serving / test teardown)."""
         for rep in self.replicas:
             if rep.state != "drained" and rep.backend is not None \
                     and rep.backend.alive():
                 info = rep.shutdown()
                 self.drained_exits.setdefault(
                     rep.name, info.get("exit_code"))
+        if self.standby_pool is not None:
+            self.standby_pool.shutdown()
         return dict(self.drained_exits)
 
 
@@ -678,6 +942,7 @@ class ProcRouter(Router):
                 f"no migration destination for {src_name!r}")
         now = self.clock.now()
         outcome, installed, skipped, error = "ok", 0, 0, None
+        draft_installed = 0
         try:
             blob = src.backend.transport.fetch_bytes("/rpc/migrate_out")
             resp = dst.backend.transport.post_bytes("/rpc/migrate_in",
@@ -688,6 +953,7 @@ class ProcRouter(Router):
                     f"{resp.get('message')}")
             installed = resp["installed"]
             skipped = resp["skipped"]
+            draft_installed = resp.get("draft_installed", 0)
         except (TransportError, EnvelopeError) as e:
             outcome, error = "failed", repr(e)
         self.supervisor._migrations.labels(outcome=outcome).inc()
@@ -723,6 +989,7 @@ class ProcRouter(Router):
             "error": error,
             "entries_installed": installed,
             "entries_skipped": skipped,
+            "draft_rows_installed": draft_installed,
             "requests_moved": sorted(moved),
             "src_exit_code": info.get("exit_code"),
         }

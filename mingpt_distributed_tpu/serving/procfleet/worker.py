@@ -47,6 +47,22 @@ retry-idempotency invariant), hits the migrated prefix entry as a
 device-side row copy, and re-derives any decoded suffix deterministically
 under the router's dedup — zero admitted requests lost, zero duplicate
 emissions, bit-identical stream.
+
+With speculation on, migration is *state-complete* (ISSUE 17): the
+blob also carries ``draft_rows`` frames — the bucket-quantized leading
+prompt rows of each in-flight slot's DRAFT pool, head-sharded under tp
+exactly like target rows. The peer parks them keyed by prompt prefix;
+when the migrated request re-admits (lockstep slot mirroring assigns a
+fresh draft slot), ``SpeculativeDecoder.prime`` adopts the parked rows
+as a device-side row copy and the request resumes *proposing* without
+a draft re-prefill.
+
+**Hangs.** A ``stuck_step`` process fault wedges the worker INSIDE the
+step RPC while holding the dispatch lock: the RPC never answers, every
+later RPC times out behind the lock, and the SIGTERM handler refuses
+to exit while wedged (a real wedge — a C loop holding the GIL — never
+runs the Python handler at all). Only SIGKILL, the supervisor's second
+escalation rung, clears the process.
 """
 
 from __future__ import annotations
@@ -70,6 +86,8 @@ from mingpt_distributed_tpu.serving.requests import QueueFullError
 from mingpt_distributed_tpu.training.faults import (
     InjectedAdmissionError,
     InjectedServingFault,
+    ProcessKilled,
+    WorkerStuck,
 )
 
 __all__ = ["ReplicaWorker", "RpcHttpServer", "main"]
@@ -92,10 +110,15 @@ class ReplicaWorker:
     one lock; the stream endpoint waits on a condition fed by the same
     emit path and never holds the lock while blocked."""
 
-    def __init__(self, server, name: str = "replica", flight=None):
+    def __init__(self, server, name: str = "replica", flight=None,
+                 pinj=None):
         self.server = server
         self.name = name
         self.flight = flight
+        self.pinj = pinj  # worker-side ProcessFaultInjector (or None)
+        #: set when a stuck_step fault wedged this worker — main()'s
+        #: SIGTERM handler consults it to model an unkillable wedge
+        self.wedged = threading.Event()
         self.draining = False
         self._lock = threading.RLock()
         # round event batch (drained by each step RPC)
@@ -166,8 +189,31 @@ class ReplicaWorker:
             "submit_result", request_id=rh.request_id,
             queue_depth=len(self.server.queue))))
 
+    def _maybe_process_fault(self) -> None:
+        """Worker-side process faults, consulted inside the step RPC
+        while the dispatch lock is held. ``stuck_step`` wedges: the RPC
+        thread blocks forever on a never-set event WITH the lock, so
+        this response and every later RPC time out at the client —
+        exactly the sticky client-side (loopback) semantics. ``kill``
+        makes the fault true: the process SIGKILLs itself mid-RPC."""
+        if self.pinj is None:
+            return
+        try:
+            self.pinj.rpc_verdict(self.name)
+        except WorkerStuck:
+            self.wedged.set()
+            if self.flight is not None:
+                self.flight.dump("stuck_step", replica=self.name,
+                                 pid=os.getpid())
+            threading.Event().wait()  # the wedge: never returns
+        except ProcessKilled:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGKILL)
+
     def _step(self) -> Tuple[int, str, bytes]:
         with self._lock:
+            self._maybe_process_fault()
             try:
                 busy = self.server.step()
             except InjectedServingFault as e:
@@ -254,8 +300,10 @@ class ReplicaWorker:
             return meta, k_np.tobytes() + v_np.tobytes()
 
         eng = self.server.engine
+        spec_dec = getattr(self.server, "spec", None)
         frames: List[Tuple[Dict[str, Any], bytes]] = []
         shipped = set()
+        draft_shipped = set()
         if eng.prefix_store is not None:
             for key, (k, v) in eng.prefix_store.entries():
                 frames.append(entry_frame("prefix_entry", key, k, v))
@@ -266,14 +314,28 @@ class ReplicaWorker:
             frontier = (h.prefill_pos if h.prefilling
                         else len(h.prompt_used))
             rows = eng.migratable_rows(len(h.prompt_used), frontier)
-            if rows <= 0:
+            if rows > 0:
+                key = tuple(int(t) for t in h.prompt_used[:rows])
+                if key not in shipped:
+                    k, v = eng.extract_slot_rows(h.slot, rows)
+                    frames.append(entry_frame("slot_rows", key, k, v))
+                    shipped.add(key)
+            if spec_dec is None or h.prefilling:
                 continue
-            key = tuple(int(t) for t in h.prompt_used[:rows])
-            if key in shipped:
+            # state-complete speculation: ship the DRAFT pool's leading
+            # prompt rows too (lockstep mirroring means the draft slot
+            # index IS h.slot). Drafts regenerate no logits from the
+            # last prompt row, so the full bucket <= prompt_len ships —
+            # a bucket-aligned prompt resumes with ZERO draft prefill.
+            drows = spec_dec.migratable_draft_rows(len(h.prompt_used))
+            if drows <= 0:
                 continue
-            k, v = eng.extract_slot_rows(h.slot, rows)
-            frames.append(entry_frame("slot_rows", key, k, v))
-            shipped.add(key)
+            dkey = tuple(int(t) for t in h.prompt_used[:drows])
+            if dkey in draft_shipped:
+                continue
+            dk, dv = spec_dec.extract_draft_rows(h.slot, drows)
+            frames.append(entry_frame("draft_rows", dkey, dk, dv))
+            draft_shipped.add(dkey)
         manifest = {
             "type": "manifest", "replica": self.name,
             "unfinished": [h.request_id for h in self.server.unfinished()],
@@ -292,14 +354,15 @@ class ReplicaWorker:
             frames = unpack_frames(blob)
         except EnvelopeError as e:
             return _error(400, "bad_frames", str(e))
-        installed = skipped = 0
+        installed = skipped = draft_installed = 0
         with self._lock:
             eng = self.server.engine
+            spec_dec = getattr(self.server, "spec", None)
             for meta, payload in frames:
                 kind = meta.get("type")
                 if kind == "manifest":
                     continue
-                if kind not in ("prefix_entry", "slot_rows"):
+                if kind not in ("prefix_entry", "slot_rows", "draft_rows"):
                     return _error(400, "bad_frames",
                                   f"unknown frame type {kind!r}")
                 kn = int(meta["k_nbytes"])
@@ -308,12 +371,21 @@ class ReplicaWorker:
                     meta["k_shape"])
                 v = np.frombuffer(payload[kn:], dtype=dt).reshape(
                     meta["v_shape"])
-                if eng.adopt_prefix_entry(meta["key"], k, v):
+                if kind == "draft_rows":
+                    # parked for SpeculativeDecoder.prime; a peer
+                    # without speculation skips — degrade, never fail
+                    if spec_dec is not None and spec_dec.adopt_draft_rows(
+                            tuple(meta["key"]), k, v):
+                        draft_installed += 1
+                    else:
+                        skipped += 1
+                elif eng.adopt_prefix_entry(meta["key"], k, v):
                     installed += 1
                 else:
                     skipped += 1
         return (200, "application/json", _json_body(envelope(
-            "migrate_in_result", installed=installed, skipped=skipped)))
+            "migrate_in_result", installed=installed, skipped=skipped,
+            draft_installed=draft_installed)))
 
     # -- streaming ------------------------------------------------------
     def stream_iter(self, request_id: str,
@@ -491,9 +563,16 @@ def build_worker_from_spec(spec: Dict[str, Any]) -> ReplicaWorker:
     injector = (ServingFaultInjector(spec["serving_faults"])
                 if spec.get("serving_faults") else None)
     hook = injector.round_hook(name) if injector is not None else None
+    server_kwargs = dict(spec.get("server", {}))
+    if spec.get("draft") == "self" and int(spec.get("spec_k", 0)) >= 1:
+        # self-speculation: the target doubles as its own draft — the
+        # cheapest way to give a subprocess worker a real draft pool
+        # (full state-complete migration coverage, ~100% greedy accept)
+        server_kwargs.update(draft_params=params, draft_cfg=cfg,
+                             spec_k=int(spec["spec_k"]))
     server = InferenceServer(
         params, cfg, clock=WallClock().now, fault_hook=hook,
-        **spec.get("server", {}))
+        **server_kwargs)
     flight = None
     spill = spec.get("spill_dir")
     if spill:
@@ -506,7 +585,16 @@ def build_worker_from_spec(spec: Dict[str, Any]) -> ReplicaWorker:
                                 registry=server.metrics.registry)
         flight.metrics_providers[name] = (
             lambda: render_prometheus(server.metrics.registry))
-    return ReplicaWorker(server, name=name, flight=flight)
+    pinj = None
+    if spec.get("process_faults"):
+        from mingpt_distributed_tpu.training.faults import (
+            ProcessFaultInjector,
+        )
+
+        # no sleep injected: slow_socket is a client-side fault; the
+        # worker-side verdicts that matter here are stuck_step and kill
+        pinj = ProcessFaultInjector(spec["process_faults"])
+    return ReplicaWorker(server, name=name, flight=flight, pinj=pinj)
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -539,7 +627,16 @@ def main(argv: Optional[List[str]] = None) -> int:
                               name=worker.name), sort_keys=True),
           flush=True)
     stop = threading.Event()
-    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+
+    def _on_term(*_):
+        if worker.wedged.is_set():
+            # wedged inside the step RPC: a real wedge (a C loop holding
+            # the GIL) never runs this handler — refuse the graceful
+            # exit so the supervisor's SIGKILL rung is genuinely needed
+            return
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_term)
     stop.wait()
     if worker.flight is not None:
         worker.flight.dump("drain", replica=worker.name,

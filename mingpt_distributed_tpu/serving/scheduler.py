@@ -606,11 +606,13 @@ class InferenceServer:
                 self.attrib.observe_call("prefix_save", self.clock() - ts0,
                                          variant=f"b{rows}")
         if self.spec is not None:
-            # one-shot draft prefill of the full prompt: draft state only
-            # shapes proposal quality, so it skips chunking/prefix reuse
+            # draft prime: a full prefill of the prompt, or — when
+            # migration parked this prompt's draft rows on us — a
+            # device-side row install plus at most a tail chunk
             tp0 = self.clock()
-            self.spec.prime(
+            mode = self.spec.prime(
                 slot, prompt, jax.random.fold_in(self.slots.req_keys[slot], 0))
+            self.metrics.on_spec_prime(mode)
             if self.attrib is not None:
                 b = self.spec.draft.engine.bucket_for(len(prompt))
                 self.attrib.observe_call("draft_prefill",

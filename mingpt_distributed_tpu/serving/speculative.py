@@ -165,6 +165,13 @@ class SpeculativeDecoder:
             functools.partial(_verify_impl, cfg=target.cfg,
                               kv_sharding=target.kv_sharding),
             donate_argnums=(1,))
+        # migrated draft state parked until the owning request re-primes
+        # (ISSUE 17): prompt-prefix key -> (k, v) rows, device-side under
+        # the draft pool's sharding. Bounded FIFO — advisory state only.
+        self.pending_draft: Dict[tuple, tuple] = {}
+        self.pending_draft_cap = 32
+        self.prime_full = 0     # primes that paid a full draft prefill
+        self.prime_adopted = 0  # primes served from migrated rows
 
     # -- slot lifecycle (mirrors the target pool) ----------------------
     def bind(self, slot: int) -> None:
@@ -173,8 +180,75 @@ class SpeculativeDecoder:
     def release(self, slot: int) -> None:
         self.draft.release(slot)
 
-    def prime(self, slot: int, prompt_ids: Sequence[int], key) -> None:
-        self.draft.prime(slot, prompt_ids, key)
+    def prime(self, slot: int, prompt_ids: Sequence[int], key) -> str:
+        """Fill the draft lane for a freshly-prefilled request. Normally
+        one full un-chunked draft prefill; when migration parked draft
+        rows for this prompt (``adopt_draft_rows``), install them
+        device-side through the compiled row-copy program and prefill
+        only the uncovered tail — a bucket-aligned prompt resumes
+        proposing with ZERO draft prefill calls. Returns the path taken
+        (``"full"`` | ``"adopted"``) so the scheduler can count it."""
+        prompt = [int(t) for t in prompt_ids]
+        best = None
+        for pkey in self.pending_draft:
+            if len(pkey) <= len(prompt) and list(pkey) == \
+                    prompt[:len(pkey)]:
+                if best is None or len(pkey) > len(best):
+                    best = pkey
+        if best is not None:
+            # one-shot: the rows now live in the slot's cache; keeping
+            # the parked copy would pin device memory for a request
+            # that already resumed
+            dk, dv = self.pending_draft.pop(best)
+            rows = self.draft.engine.install_slot_rows(slot, dk, dv)
+            if rows < len(prompt):
+                self.draft.engine.prefill_chunk_call(
+                    slot, prompt[rows:], rows, 1.0, None, None, False,
+                    key)
+            self.prime_adopted += 1
+            return "adopted"
+        self.draft.prime(slot, prompt, key)
+        self.prime_full += 1
+        return "full"
+
+    # -- draft-state migration (ISSUE 17) ------------------------------
+    def migratable_draft_rows(self, prompt_len: int) -> int:
+        """Rows worth shipping from a primed draft lane: the largest
+        ladder bucket <= prompt_len. Unlike the target's
+        ``migratable_rows`` there is no ``- 1`` — the draft never
+        regenerates prompt logits, so a bucket-aligned prompt ships its
+        WHOLE primed cache and the peer's re-prime prefills nothing."""
+        best = 0
+        for b in self.draft.engine.buckets:
+            if b <= prompt_len:
+                best = b
+        return best
+
+    def extract_draft_rows(self, slot: int, rows: int):
+        """The extract half of draft migration — same compiled row-copy
+        family as the target's, on the draft pool."""
+        return self.draft.engine.extract_slot_rows(slot, rows)
+
+    def adopt_draft_rows(self, key: Sequence[int], k, v) -> bool:
+        """Park migrated draft rows (host arrays off the transfer
+        channel) until the re-routed request's ``prime``, re-placed
+        under the draft pool's sharding so adopted rows stay
+        head-sharded under tp exactly like locally-primed ones. Bounded
+        FIFO; returns False when already present."""
+        key = tuple(int(t) for t in key)
+        if key in self.pending_draft:
+            return False
+        eng = self.draft.engine
+        if eng.kv_sharding is not None:
+            k = jax.device_put(k, eng.kv_sharding)
+            v = jax.device_put(v, eng.kv_sharding)
+        else:
+            k = jnp.asarray(k)
+            v = jnp.asarray(v)
+        while len(self.pending_draft) >= self.pending_draft_cap:
+            self.pending_draft.pop(next(iter(self.pending_draft)))
+        self.pending_draft[key] = (k, v)
+        return True
 
     # -- eligibility ---------------------------------------------------
     def eligible(self, do_sample: bool, position: int) -> bool:

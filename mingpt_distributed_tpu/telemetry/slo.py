@@ -15,7 +15,10 @@ Spec grammar (CLI ``--slo`` and ``parse_slo_spec``)::
 
 Metrics: ``ttft_pNN`` (seconds, per-request time-to-first-token),
 ``itl_pNN`` (seconds, pooled inter-token gaps across all requests),
-``shed_rate`` and ``error_rate`` (fractions of all finished requests).
+``recovery_pNN`` (seconds, per-request time from a replica fault to
+the first token the replacement emitted — the recovery tail; only
+requests a crash actually re-routed carry the sample), ``shed_rate``
+and ``error_rate`` (fractions of all finished requests).
 Report schema: ``mingpt-slo/1``.
 """
 
@@ -28,7 +31,7 @@ SLO_SCHEMA = "mingpt-slo/1"
 
 DEFAULT_SLO_SPEC = "ttft_p99<=0.5,itl_p99<=0.1,shed_rate<=0.05"
 
-_METRIC_RE = re.compile(r"^(ttft|itl)_p(\d{1,2})$")
+_METRIC_RE = re.compile(r"^(ttft|itl|recovery)_p(\d{1,2})$")
 _RATE_METRICS = ("shed_rate", "error_rate")
 
 #: grade ladder: fraction of evaluable objectives attained -> letter
@@ -48,7 +51,7 @@ class SLObjective:
                 self.metric not in _RATE_METRICS:
             raise ValueError(
                 f"unknown SLO metric {self.metric!r} (want ttft_pNN, "
-                f"itl_pNN, shed_rate or error_rate)")
+                f"itl_pNN, recovery_pNN, shed_rate or error_rate)")
         if not math.isfinite(self.threshold) or self.threshold < 0:
             raise ValueError(
                 f"SLO threshold must be finite and >= 0, "
@@ -102,6 +105,11 @@ def _observe(metric: str, requests: Sequence[Dict[str, Any]],
         if field == "ttft":
             vals = [r["ttft_s"] for r in requests
                     if r.get("ttft_s") is not None]
+        elif field == "recovery":
+            # only requests a fault actually re-routed carry the sample
+            # (fault observed -> first token from the replacement)
+            vals = [r["recovery_s"] for r in requests
+                    if r.get("recovery_s") is not None]
         else:
             vals = [g for r in requests for g in (r.get("itl_s") or [])]
         return exact_quantile(vals, pct)

@@ -51,8 +51,8 @@ __all__ = [
 TRAFFIC_SCHEMA = "mingpt-traffic/1"
 
 _POLICY_CELL_KEYS = frozenset({
-    "slo", "deadline_hit_rate", "deadline_requests", "completed",
-    "shed", "expired", "errors", "tokens", "rounds",
+    "slo", "deadline_hit_rate", "deadline_requests", "recovered",
+    "completed", "shed", "expired", "errors", "tokens", "rounds",
     "virtual_duration_s",
 })
 

@@ -33,7 +33,11 @@ from mingpt_distributed_tpu.serving.fleet import (
     default_server_factory,
 )
 from mingpt_distributed_tpu.serving.requests import ShedError
-from mingpt_distributed_tpu.telemetry.slo import evaluate_slos, parse_slo_spec
+from mingpt_distributed_tpu.telemetry.slo import (
+    DEFAULT_SLO_SPEC,
+    evaluate_slos,
+    parse_slo_spec,
+)
 from mingpt_distributed_tpu.telemetry.tracing import TraceRecorder
 from mingpt_distributed_tpu.trafficlab.arrivals import (
     arrival_times,
@@ -77,9 +81,22 @@ class SweepSpec:
     slo: str = "default"
     knee_objective: Optional[str] = None  # None: first objective in spec
     chaos_spec: Optional[str] = None
+    #: recovery-tail objective (ISSUE 17): when set, appends
+    #: ``recovery_p99<=X`` to the SLO spec — p99 of per-request
+    #: fault -> first-replacement-token time, so a chaos sweep grades
+    #: how fast failover is, not just whether streams stay exact
+    recovery_slo_s: Optional[float] = None
     shed_watermark: Optional[int] = None
     prefix_cache_mb: float = 0.0
     max_rounds: int = 200_000
+
+    def effective_slo(self) -> str:
+        """The SLO spec with the recovery-tail objective folded in."""
+        if self.recovery_slo_s is None:
+            return self.slo
+        base = (DEFAULT_SLO_SPEC if self.slo.strip() == "default"
+                else self.slo)
+        return f"{base},recovery_p99<={self.recovery_slo_s:g}"
 
     def validate(self) -> None:
         parse_arrival_spec(self.arrival)
@@ -96,7 +113,10 @@ class SweepSpec:
             make_policy(p)
         if self.n_requests < 1:
             raise ValueError("n_requests must be >= 1")
-        parse_slo_spec(self.slo)
+        if self.recovery_slo_s is not None and self.recovery_slo_s <= 0:
+            raise ValueError(
+                f"recovery_slo_s must be > 0, got {self.recovery_slo_s}")
+        parse_slo_spec(self.effective_slo())
 
 
 def _run_one(params, cfg, spec: SweepSpec, policy_name: str,
@@ -179,10 +199,14 @@ def _run_one(params, cfg, spec: SweepSpec, policy_name: str,
             if outcome in ("length", "eos"):
                 deadline_hit += 1
     return {
-        "slo": evaluate_slos(rows, parse_slo_spec(spec.slo)),
+        "slo": evaluate_slos(rows, parse_slo_spec(spec.effective_slo())),
         "deadline_hit_rate": (
             (deadline_hit / deadline_total) if deadline_total else None),
         "deadline_requests": deadline_total,
+        # requests a crash re-routed (their summaries carry recovery_s:
+        # fault observed -> first token from the replacement replica)
+        "recovered": sum(1 for row in rows
+                         if row.get("recovery_s") is not None),
         "completed": counts["completed"],
         "shed": counts["shed"],
         "expired": counts["expired"],
@@ -205,7 +229,7 @@ def run_sweep(params, cfg, spec: SweepSpec,
                           block_size=cfg.block_size)
     mix.validate()
     base = parse_arrival_spec(spec.arrival)
-    objectives = parse_slo_spec(spec.slo)
+    objectives = parse_slo_spec(spec.effective_slo())
     knee_objective = (spec.knee_objective if spec.knee_objective
                       else objectives[0].name)
     if knee_objective not in {o.name for o in objectives}:
@@ -237,7 +261,7 @@ def run_sweep(params, cfg, spec: SweepSpec,
         "seed": spec.seed,
         "arrival": spec_to_json(base),
         "mix": mix.to_json(),
-        "slo_spec": spec.slo,
+        "slo_spec": spec.effective_slo(),
         "knee_objective": knee_objective,
         "chaos_spec": spec.chaos_spec,
         "fleet": {"n_replicas": spec.n_replicas, "n_slots": spec.n_slots,

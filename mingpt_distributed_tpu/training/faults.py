@@ -49,8 +49,12 @@ process dies as if SIGKILLed; over a real socket the supervisor actually
 sends SIGKILL), ``hang`` (one RPC times out; the replica survives, the
 round is lost), ``slow_socket`` (the RPC is slow: virtual clock skew on
 the deterministic loopback transport, or an injectable ``sleep`` per the
-``RetryPolicy.sleep`` idiom when a real socket is in play). ``match``
-filters on the replica name; the env knob is
+``RetryPolicy.sleep`` idiom when a real socket is in play), and
+``stuck_step`` (the worker *enters* the step RPC and never returns —
+distinct from a socket-level ``hang``, which loses one round and moves
+on: a stuck worker stays wedged, every later RPC times out too, and
+only the supervisor's SIGTERM→SIGKILL escalation ladder recovers it).
+``match`` filters on the replica name; the env knob is
 ``MINGPT_PROCESS_FAULTS``.
 """
 
@@ -77,7 +81,7 @@ PROCESS_ENV_VAR = "MINGPT_PROCESS_FAULTS"
 #: is validated at construction.
 IO_OPS = ("write", "read")
 SERVING_OPS = ("crash", "poison", "slow", "admit")
-PROCESS_OPS = ("kill", "hang", "slow_socket")
+PROCESS_OPS = ("kill", "hang", "slow_socket", "stuck_step")
 
 
 @dataclass
@@ -396,6 +400,15 @@ class InjectedHang(InjectedServingFault):
     the same contract as a poisoned in-process round."""
 
 
+class WorkerStuck(InjectedHang):
+    """The worker entered the step RPC and never returned. Unlike a
+    plain ``hang`` this is *sticky*: the injector remembers the wedge,
+    so every subsequent RPC to the same replica times out too — waitpid
+    sees a live process, the socket sees only timeouts, and the only way
+    out is the supervisor's liveness deadline escalating
+    SIGTERM → SIGKILL."""
+
+
 class ProcessFaultInjector:
     """Deterministic fault schedule over the procfleet RPC boundary,
     sharing :class:`FaultSpec`'s grammar and counters with the other
@@ -404,8 +417,13 @@ class ProcessFaultInjector:
     * ``rpc_verdict(replica)`` — before each step RPC. Raises
       :class:`ProcessKilled` for a due ``kill`` (over a real socket the
       supervisor turns this into an actual SIGKILL of the subprocess),
-      raises :class:`InjectedHang` for a due ``hang``, and returns the
-      injected delay seconds for a due ``slow_socket`` (0.0 otherwise).
+      raises :class:`InjectedHang` for a due ``hang``, raises
+      :class:`WorkerStuck` for a due ``stuck_step`` — and, because a
+      stuck worker never comes back on its own, keeps raising
+      ``WorkerStuck`` for that replica on every later call until
+      :meth:`reset` (the supervisor resets on respawn) — and returns
+      the injected delay seconds for a due ``slow_socket`` (0.0
+      otherwise).
 
     ``sleep`` is injectable per the ``RetryPolicy.sleep`` idiom: the
     deterministic loopback transport leaves it ``None`` and lands the
@@ -425,6 +443,7 @@ class ProcessFaultInjector:
                     f"{SERVING_ENV_VAR})")
         self.sleep = sleep
         self.fired: List[str] = []  # "(op, replica)" audit trail
+        self._stuck: set = set()    # replicas wedged by stuck_step
 
     def _fire(self, op: str, replica: str) -> Optional[FaultSpec]:
         for s in self.specs:
@@ -437,19 +456,40 @@ class ProcessFaultInjector:
         for s in self.specs:
             s.count = 0
         self.fired = []
+        self._stuck = set()
+
+    def is_stuck(self, replica: str) -> bool:
+        """True once a ``stuck_step`` fired for ``replica`` and it has
+        not been :meth:`reset` — the wedge is permanent until the
+        supervisor replaces the process."""
+        return replica in self._stuck
+
+    def reset(self, replica: str) -> None:
+        """Clear the wedge for ``replica`` — called by the supervisor on
+        respawn (the replacement process is not stuck)."""
+        self._stuck.discard(replica)
 
     def rpc_verdict(self, replica: str) -> float:
-        """Kill/hang/slow verdict for one RPC round against ``replica``.
-        Raises ProcessKilled or InjectedHang, or returns injected delay
-        seconds. When ``sleep`` was injected the delay is slept here and
-        0.0 is returned (real-socket mode); otherwise the caller adds it
-        to the replica's clock skew (deterministic loopback mode)."""
+        """Kill/hang/slow/stuck verdict for one RPC round against
+        ``replica``. Raises ProcessKilled, InjectedHang or WorkerStuck,
+        or returns injected delay seconds. When ``sleep`` was injected
+        the delay is slept here and 0.0 is returned (real-socket mode);
+        otherwise the caller adds it to the replica's clock skew
+        (deterministic loopback mode)."""
+        if replica in self._stuck:
+            raise WorkerStuck(
+                f"replica {replica} is wedged in step; RPC timed out")
         if self._fire("kill", replica) is not None:
             raise ProcessKilled(
                 f"injected kill: replica process {replica} died")
         if self._fire("hang", replica) is not None:
             raise InjectedHang(
                 f"injected hang: RPC to replica {replica} timed out")
+        if self._fire("stuck_step", replica) is not None:
+            self._stuck.add(replica)
+            raise WorkerStuck(
+                f"injected stuck_step: replica {replica} entered step "
+                f"and never returned")
         spec = self._fire("slow_socket", replica)
         if spec is None:
             return 0.0

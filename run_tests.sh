@@ -168,6 +168,25 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest-procfleet --spill-dir "$OBS_DIR/spill"
 
+# Warm-standby failover gate (ISSUE 17): the same fault, raced two
+# ways. Kill -9 a worker mid-decode over a plain supervisor (cold
+# respawn) and again over one holding a pre-warmed spare: both runs
+# must stay token-exact with zero duplicate/lost stream tokens, and the
+# standby adoption must record a strictly smaller crash->serving
+# recovery than the cold path, then backfill the pool. Then wedge a
+# worker INSIDE the step RPC (the stuck_step process fault holds the
+# dispatch lock and refuses SIGTERM): the liveness ladder must escalate
+# SIGTERM -> SIGKILL within the configured deadline and recover the
+# streams through adoption. Finally migrate a mid-flight speculative
+# request: the draft-pool rows ride the mingpt-rpc/1 channel and the
+# peer must prime from them (spec_prime_total{mode="adopted"}) instead
+# of re-prefilling the draft, token-identical to solo generate().
+# Exits non-zero on any violation.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-standby --spill-dir "$OBS_DIR/standby-spill"
+
 # The exported artifacts must round-trip through the offline tool too:
 # trace_summary renders per-request timelines + the SLO grade from the
 # same files the gate just validated in-process, and --compare diffs

@@ -192,6 +192,20 @@ def build_argparser() -> argparse.ArgumentParser:
                         "spill state (spec.json, stderr.log, flight dumps "
                         "collected by the supervisor on process death); "
                         "default: a temp directory")
+    p.add_argument("--standby", type=int, default=0, metavar="N",
+                   help="process isolation: keep N pre-warmed spare "
+                        "workers (fully spawned, params restored, program "
+                        "family warm); a crashed replica adopts a hot "
+                        "spare instead of paying a cold respawn, and the "
+                        "pool backfills off the recovery critical path "
+                        "(default 0: cold respawns only)")
+    p.add_argument("--hang-deadline", type=float, default=None,
+                   metavar="SECONDS",
+                   help="process isolation: arm the liveness escalation "
+                        "ladder — a replica holding work that completes "
+                        "no round for this long gets SIGTERM, then "
+                        "SIGKILL after a grace window if it ignored the "
+                        "term (wedged worker); default: no ladder")
     p.add_argument("--chaos-spec", default=None,
                    help="deterministic serving fault spec, e.g. "
                         "'crash:nth=6:match=replica0;slow:every=1:"
@@ -239,6 +253,15 @@ def build_argparser() -> argparse.ArgumentParser:
                         "and verify the migrated streams are bit-identical "
                         "with mingpt-trace/1 timelines spanning both "
                         "replicas; then exits")
+    p.add_argument("--selftest-standby", action="store_true",
+                   help="ISSUE 17 gate: real replica subprocesses again — "
+                        "kill -9 under a warm-standby pool and verify the "
+                        "adoption recovers strictly faster than the cold "
+                        "respawn on the same fault; wedge a worker inside "
+                        "the step RPC and verify the SIGTERM->SIGKILL "
+                        "escalation ladder clears it; migrate a "
+                        "speculative request and verify the peer resumes "
+                        "proposing from shipped draft rows; then exits")
     p.add_argument("--selftest-attrib", action="store_true",
                    help="ISSUE 13 gate: per-program attribution ledger "
                         "(prefill/decode/verify/draft/train families with "
@@ -1839,10 +1862,264 @@ def selftest_procfleet(args) -> int:
     return rc
 
 
+def selftest_standby(args) -> int:
+    """The ISSUE 17 acceptance gate, against REAL subprocesses.
+
+    Phase A — cold vs standby on the same fault: kill -9 a mid-decode
+    worker twice, once over a plain supervisor and once with a warm
+    spare. Both runs must stay token-exact with zero duplicate or lost
+    stream tokens; the standby run must record a strictly smaller
+    crash->serving recovery time, label it ``path="standby"``, and
+    backfill the pool after the adoption.
+
+    Phase B — hang escalation: a worker wedges inside the step RPC (the
+    ``stuck_step`` process fault, worker-side) and refuses SIGTERM; the
+    liveness ladder must escalate SIGTERM -> SIGKILL within the
+    configured deadline, the crash path recovers through standby
+    adoption, and every stream stays exact.
+
+    Phase C — speculative-state-complete migration: workers run
+    self-speculation; ``migrate_and_drain`` must ship draft-pool rows
+    and the destination must prime the migrated request from them
+    (``spec_prime_total{mode="adopted"}``) with output token-identical
+    to solo generate()."""
+    import signal
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import generate as gen
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import (
+        ProcRouter,
+        ProcessSupervisor,
+        Request,
+        WallClock,
+        process_backend_factory,
+    )
+    from mingpt_distributed_tpu.telemetry import parse_prometheus
+
+    cfg_kw = dict(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    cfg = GPTConfig.make(**cfg_kw)
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's",
+              "Now is the winter"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 10
+
+    def solo(p, n):
+        out = gen.generate(params, cfg, jnp.asarray(p, jnp.int32)[None], n)
+        return np.asarray(out)[0, len(p):].tolist()
+
+    spill_root = args.spill_dir or tempfile.mkdtemp(prefix="standby-")
+    rc = 0
+
+    def build_fleet(spill, spec, **sup_kwargs):
+        streamed = {}
+
+        def on_token(fh, tok):
+            streamed.setdefault(fh.request_id, []).append(tok)
+
+        supervisor = ProcessSupervisor(
+            process_backend_factory(
+                spec, spill,
+                rpc_timeout_s=sup_kwargs.pop("rpc_timeout_s", 120.0)),
+            n_replicas=2, clock=WallClock(), max_restarts=1,
+            restart_backoff_s=0.05, **sup_kwargs)
+        router = ProcRouter(supervisor, on_token=on_token, max_retries=3,
+                            retry_backoff_s=0.01, breaker_reset_s=0.05)
+        return supervisor, router, streamed
+
+    def mid_decode_replica(supervisor, router):
+        for (name, _), (fh, rh) in router._attempts.items():
+            rep = supervisor.replica_by_name(name)
+            if (rep.state == "ready" and not rh.finished
+                    and len(rh.tokens) >= 1):
+                return rep
+        return None
+
+    def check_parity(tag, handles, streamed):
+        ok = True
+        for p, h in zip(prompts, handles):
+            want = solo(p, max_new)
+            if h.finish_reason != "length" or h.tokens != want:
+                print(f"selftest-standby FAIL ({tag}) {h.request_id}: "
+                      f"reason={h.finish_reason} fleet={h.tokens} "
+                      f"solo={want}")
+                ok = False
+            if streamed.get(h.request_id, []) != h.tokens:
+                print(f"selftest-standby FAIL ({tag}) {h.request_id}: "
+                      f"streamed {streamed.get(h.request_id)} != handle "
+                      f"{h.tokens} (duplicate or lost emission)")
+                ok = False
+        return ok
+
+    # -- Phase A: cold vs standby recovery on the same fault ----------
+    def run_kill(tag, standby):
+        spec = {"cfg": cfg_kw, "init_seed": 0,
+                "server": {"n_slots": 2, "prefill_chunk": 8}}
+        supervisor, router, streamed = build_fleet(
+            os.path.join(spill_root, tag), spec, standby=standby)
+        handles = [router.submit(Request(prompt=p, max_new_tokens=max_new))
+                   for p in prompts]
+        victim = None
+        for _ in range(2000):
+            router.step()
+            victim = mid_decode_replica(supervisor, router)
+            if victim is not None:
+                break
+        if victim is None:
+            print(f"selftest-standby FAIL ({tag}): never mid-decode")
+            return None, False, supervisor
+        os.kill(victim.backend.pid, signal.SIGKILL)
+        router.run_until_drained(max_steps=20000)
+        for _ in range(2000):
+            if supervisor.replica_by_name(victim.name).state == "ready":
+                break
+            router.step()
+        ok = check_parity(tag, handles, streamed)
+        rec = supervisor.recovery_info(victim.name)
+        return rec, ok, supervisor
+
+    rec_cold, ok_cold, sup_cold = run_kill("cold", standby=0)
+    rec_stby, ok_stby, sup_stby = run_kill("standby", standby=1)
+    pool_refilled = (sup_stby.standby_pool is not None
+                     and sup_stby.standby_pool.available() == 1)
+    sup_cold.shutdown_all()
+    sup_stby.shutdown_all()
+    checks_a = [
+        ("cold run stayed token-exact", ok_cold),
+        ("standby run stayed token-exact", ok_stby),
+        ("cold respawn recorded path=cold",
+         rec_cold is not None and rec_cold["path"] == "cold"),
+        ("standby respawn recorded path=standby",
+         rec_stby is not None and rec_stby["path"] == "standby"),
+        ("a spare was adopted by name",
+         rec_stby is not None
+         and str(rec_stby["adopted"]).startswith("standby")),
+        ("standby recovery strictly beat cold on the same fault",
+         rec_cold is not None and rec_stby is not None
+         and rec_stby["recovery_s"] < rec_cold["recovery_s"]),
+        ("the pool was backfilled after adoption", pool_refilled),
+    ]
+    if rec_cold and rec_stby:
+        print(f"selftest-standby recovery: cold="
+              f"{rec_cold['recovery_s']:.3f}s standby="
+              f"{rec_stby['recovery_s']:.3f}s "
+              f"(adopted {rec_stby['adopted']})")
+    for what, ok in checks_a:
+        if not ok:
+            print(f"selftest-standby FAIL (phase A): {what}")
+            rc = 1
+
+    # -- Phase B: stuck_step -> SIGTERM -> SIGKILL ladder -------------
+    spec_b = {"cfg": cfg_kw, "init_seed": 0,
+              "server": {"n_slots": 2, "prefill_chunk": 8},
+              "process_faults": "stuck_step:nth=3:match=replica0"}
+    supervisor, router, streamed = build_fleet(
+        os.path.join(spill_root, "hang"), spec_b, standby=1,
+        hang_deadline_s=1.0, hang_kill_grace_s=1.0, rpc_timeout_s=2.0)
+    # the initial workers (and the spare) already read their specs;
+    # respawns and backfills must come up clean, or the replacement
+    # wedges again on ITS third step
+    spec_b.pop("process_faults")
+    first_pid = supervisor.replica_by_name("replica0").backend.pid
+    handles = [router.submit(Request(prompt=p, max_new_tokens=max_new))
+               for p in prompts]
+    router.run_until_drained(max_steps=20000)
+    for _ in range(2000):
+        if supervisor.replica_by_name("replica0").state == "ready":
+            break
+        router.step()
+    ok_b = check_parity("hang", handles, streamed)
+    page = router.fleet_metrics_page()
+    esc = {}
+    for sname, labels, value in parse_prometheus(page)["samples"]:
+        if sname == "mingpt_fleet_hang_escalations_total":
+            esc[labels.get("signal")] = value
+    crash = next((c for c in supervisor.crash_reports
+                  if c["replica"] == "replica0"), None)
+    rep0 = supervisor.replica_by_name("replica0")
+    checks_b = [
+        ("streams stayed exact through the wedge", ok_b),
+        ("the ladder fired SIGTERM first", esc.get("term", 0) >= 1),
+        ("SIGTERM was refused, SIGKILL followed", esc.get("kill", 0) >= 1),
+        ("the wedged worker died of SIGKILL",
+         crash is not None and crash["exit_code"] == -signal.SIGKILL),
+        ("the replacement is a new, serving process",
+         rep0.state == "ready" and rep0.backend.pid != first_pid),
+    ]
+    for what, ok in checks_b:
+        if not ok:
+            print(f"selftest-standby FAIL (phase B): {what}")
+            rc = 1
+    print(f"selftest-standby escalations: {esc} "
+          f"(exit={None if crash is None else crash['exit_code']})")
+    supervisor.shutdown_all()
+
+    # -- Phase C: draft rows ride the migration -----------------------
+    spec_c = {"cfg": cfg_kw, "init_seed": 0, "draft": "self", "spec_k": 3,
+              "server": {"n_slots": 2, "prefill_chunk": 8,
+                         "prefix_cache_mb": 4.0}}
+    supervisor, router, streamed = build_fleet(
+        os.path.join(spill_root, "spec"), spec_c)
+    handles = [router.submit(Request(prompt=p, max_new_tokens=max_new))
+               for p in prompts]
+    src = None
+    for _ in range(2000):
+        router.step()
+        src = mid_decode_replica(supervisor, router)
+        if src is not None:
+            break
+    if src is None:
+        print("selftest-standby FAIL (phase C): never mid-decode")
+        rc = 1
+        report = {}
+    else:
+        report = router.migrate_and_drain(src.name)
+        print(f"selftest-standby migration: {json.dumps(report)}")
+        router.run_until_drained(max_steps=20000)
+    ok_c = check_parity("spec", handles, streamed)
+    adopted_primes = 0.0
+    dst = (supervisor.replica_by_name(report["to"])
+           if report.get("to") else None)
+    if dst is not None and dst.backend is not None:
+        page = dst.backend.transport.fetch_text("/metrics")
+        for sname, labels, value in parse_prometheus(page)["samples"]:
+            if (sname == "mingpt_serve_spec_prime_total"
+                    and labels.get("mode") == "adopted"):
+                adopted_primes = value
+    checks_c = [
+        ("migrated speculative streams stayed token-exact", ok_c),
+        ("migration shipped state (outcome=ok)",
+         report.get("outcome") == "ok"),
+        ("draft-pool rows rode the transfer channel",
+         report.get("draft_rows_installed", 0) >= 1),
+        ("the peer primed from shipped rows, not a re-prefill",
+         adopted_primes >= 1),
+    ]
+    for what, ok in checks_c:
+        if not ok:
+            print(f"selftest-standby FAIL (phase C): {what}")
+            rc = 1
+    exits = supervisor.shutdown_all()
+    print(f"selftest-standby worker exits: {exits}")
+    print("selftest-standby", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     if args.selftest_procfleet:
         return selftest_procfleet(args)
+    if args.selftest_standby:
+        return selftest_standby(args)
     if args.selftest_sharded:
         return selftest_sharded(args)
     if args.selftest_attrib:
@@ -1949,6 +2226,8 @@ def main(argv=None) -> int:
                 clock=WallClock(),
                 process_injector=pinj if pinj.specs else None,
                 registry=reg,
+                standby=max(0, args.standby),
+                hang_deadline_s=args.hang_deadline,
             )
             router = ProcRouter(supervisor, on_token=stream_cb,
                                 shed_watermark=args.shed_watermark,

@@ -45,3 +45,25 @@ def slow_socket_fault(clock, skew_s):
 
 def injectable_rpc_retry(sleep=time.sleep):  # clean: reference, not call
     return sleep
+
+
+def hang_deadline_bad(term_at):
+    # a wall read deciding the SIGTERM->SIGKILL escalation would make
+    # the ladder unreplayable on a virtual clock
+    return time.time() - term_at  # expect: GL007
+
+
+def standby_prewarm_bad():
+    time.sleep(0.2)  # expect: GL007
+
+
+def liveness_ladder(clock, since, deadline_s):
+    # clean: the escalation deadline reads the supervisor's injected
+    # clock, so the whole ladder replays deterministically
+    return clock.now() - since >= deadline_s
+
+
+def standby_spare_clock(fleet_clock):
+    # clean: a spare's SkewedClock is seeded from the fleet clock it
+    # will serve under, not from the wall
+    return fleet_clock.now()

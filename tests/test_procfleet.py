@@ -15,7 +15,16 @@ sleep-free and replayable on a virtual clock:
   token-identical to solo generate(), with a prefix hit from the
   shipped rows;
 * migrated prefix entries stay head-sharded under tp=2 — adoption is a
-  ``device_put`` under the destination pool's sharding, never a gather.
+  ``device_put`` under the destination pool's sharding, never a gather;
+* warm-standby failover (ISSUE 17): adopting a pre-warmed spare records
+  a strictly smaller recovery than a cold respawn of the same kill -9,
+  stamps a ``failover`` trace event, and backfills the pool;
+* the liveness ladder escalates a wedged worker SIGTERM -> SIGKILL
+  (the wedge refuses SIGTERM; only the kill rung clears it);
+* an exhausted pool falls back to a cold respawn LOUDLY;
+* a migrated speculative request resumes proposing from the shipped
+  draft-pool rows — zero draft prefill for a bucket-aligned prompt —
+  and adopted draft rows stay head-sharded under tp=2.
 """
 
 import json
@@ -41,6 +50,8 @@ from mingpt_distributed_tpu.serving.procfleet import (
     unpack_frames,
     validate_envelope,
 )
+from mingpt_distributed_tpu.telemetry import parse_prometheus
+from mingpt_distributed_tpu.telemetry.tracing import TraceRecorder
 from mingpt_distributed_tpu.training.faults import ProcessFaultInjector
 
 
@@ -59,7 +70,7 @@ def solo_greedy(params, cfg, prompt, n):
 
 
 def make_procfleet(cfg_params, n_replicas=2, pspec=None, server_kwargs=None,
-                   **router_kw):
+                   sup_kwargs=None, **router_kw):
     """A loopback-transport process fleet on a virtual clock with fast
     backoffs — shape-identical to the real-socket fleet (same RPC bytes,
     same exit-code conventions) but fully deterministic."""
@@ -73,6 +84,7 @@ def make_procfleet(cfg_params, n_replicas=2, pspec=None, server_kwargs=None,
         process_injector=pinj,
         max_restarts=router_kw.pop("max_restarts", 1),
         restart_backoff_s=0.01,
+        **(sup_kwargs or {}),
     )
     streamed = {}
     router = ProcRouter(
@@ -325,3 +337,239 @@ def test_migrated_prefix_entries_stay_head_sharded_tp2(cfg_params):
             assert shard[3] * 2 == arr.shape[3], (
                 f"migrated entry (rows={len(key)}) not head-sharded: "
                 f"{arr.shape} -> {shard}")
+
+
+# ---------------------------------------------------------------------------
+# warm-standby failover (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+
+class _EventSink:
+    """Trace sink collecting mirrored (kind, record) pairs in order."""
+
+    def __init__(self):
+        self.records = []
+
+    def write(self, kind, rec):
+        self.records.append((kind, rec))
+
+    def close(self):
+        pass
+
+
+def _kill_run(cfg_params, standby):
+    """One kill -9 on replica0's third step, drained to completion and
+    stepped until the victim respawned; the standby axis is the only
+    difference between runs, so the recorded recoveries compare the two
+    paths on the SAME fault trace."""
+    sink = _EventSink()
+    recorder = TraceRecorder(sink=sink)
+    router, sup, streamed = make_procfleet(
+        cfg_params, pspec="kill:nth=3:match=replica0",
+        sup_kwargs=dict(standby=standby), trace_recorder=recorder)
+    handles = [router.submit(Request(prompt=p, max_new_tokens=6))
+               for p in PROMPTS]
+    router.run_until_drained(max_steps=10000)
+    for _ in range(500):
+        if sup.recovery_log:
+            break
+        router.step()
+    return router, sup, handles, streamed, sink
+
+
+def test_standby_adoption_beats_cold_respawn(cfg_params):
+    cfg, params = cfg_params
+    runs = {path: _kill_run(cfg_params, standby)
+            for path, standby in (("cold", 0), ("standby", 1))}
+    for router, sup, handles, streamed, _ in runs.values():
+        for p, h in zip(PROMPTS, handles):
+            assert h.finish_reason == "length"
+            assert h.tokens == solo_greedy(params, cfg, p, 6)
+            # zero duplicate or lost tokens across the failover
+            assert streamed[h.request_id] == h.tokens
+    rec_cold = runs["cold"][1].recovery_log[0]
+    rec_stby = runs["standby"][1].recovery_log[0]
+    assert rec_cold["path"] == "cold" and rec_cold["adopted"] is None
+    assert rec_stby["path"] == "standby"
+    assert rec_stby["adopted"] == "standby0"
+    # adoption skips the cold-spawn backoff entirely: strictly faster
+    # on the same fault, never merely equal
+    assert rec_stby["recovery_s"] < rec_cold["recovery_s"]
+    # the pool was backfilled AFTER the adoption (spawn cost lands off
+    # the recovery window just recorded)
+    assert runs["standby"][1].standby_pool.available() == 1
+    events = [rec for kind, rec in runs["standby"][4].records
+              if kind == "event" and rec["name"] == "failover"]
+    assert events, "no failover trace event stamped"
+    for e in events:
+        assert e["from_replica"] == "replica0"
+        assert e["to_replica"] == "standby0"
+        assert e["path"] == "standby"
+    page = parse_prometheus(runs["standby"][0].fleet_metrics_page())
+    got = {(n, tuple(sorted(l.items()))): v for n, l, v in page["samples"]}
+    assert got[("mingpt_fleet_standby_adoptions_total", ())] == 1
+    assert got[("mingpt_fleet_standby_pool_size", ())] == 1
+
+
+def test_hang_escalation_sigterm_then_sigkill(cfg_params):
+    """A stuck_step wedge freezes replica0's step progress while its
+    mirrored load stays nonzero: the ladder must fire SIGTERM first
+    (refused — the wedged worker's handler can never run), SIGKILL
+    after the grace, and the crash path recovers through adoption."""
+    cfg, params = cfg_params
+    router, sup, streamed = make_procfleet(
+        cfg_params, pspec="stuck_step:nth=3:match=replica0",
+        sup_kwargs=dict(standby=1, hang_deadline_s=0.01,
+                        hang_kill_grace_s=0.005))
+    ladder = []
+    orig = sup.poll_liveness
+
+    def spy():
+        out = orig()
+        ladder.extend(out)
+        return out
+
+    sup.poll_liveness = spy
+    handles = [router.submit(Request(prompt=p, max_new_tokens=6))
+               for p in PROMPTS]
+    router.run_until_drained(max_steps=10000)
+    for p, h in zip(PROMPTS, handles):
+        assert h.finish_reason == "length"
+        assert h.tokens == solo_greedy(params, cfg, p, 6)
+        assert streamed[h.request_id] == h.tokens
+    assert ladder == [("replica0", "term"), ("replica0", "kill")]
+    crash = next(c for c in sup.crash_reports
+                 if c["replica"] == "replica0")
+    assert crash["exit_code"] == -9  # SIGTERM did NOT produce exit 75
+    rec = sup.recovery_info("replica0")
+    assert rec is not None and rec["path"] == "standby"
+    assert sup.replica_by_name("replica0").state == "ready"
+    page = parse_prometheus(router.fleet_metrics_page())
+    esc = {l.get("signal"): v for n, l, v in page["samples"]
+           if n == "mingpt_fleet_hang_escalations_total"}
+    assert esc == {"term": 1, "kill": 1}
+
+
+def test_hang_deadline_none_never_escalates(cfg_params):
+    """Without a deadline the ladder is inert — a wedged replica is the
+    restart budget's problem, and an idle fleet is never judged."""
+    router, sup, _ = make_procfleet(cfg_params, sup_kwargs=dict(standby=0))
+    assert sup.poll_liveness() == []
+    h = router.submit(Request(prompt=PROMPTS[0], max_new_tokens=4))
+    router.run_until_drained(max_steps=2000)
+    assert h.finish_reason == "length"
+    assert sup.poll_liveness() == []
+
+
+def test_standby_pool_exhausted_falls_back_cold_loudly(cfg_params, capsys):
+    """Both replicas die in the same round against a 1-deep pool: the
+    first respawn adopts the spare, the second must cold-spawn and SAY
+    SO on stderr — a silent fallback would hide that the fleet is
+    running without its recovery-latency insurance."""
+    cfg, params = cfg_params
+    router, sup, streamed = make_procfleet(
+        cfg_params,
+        pspec="kill:nth=3:match=replica0;kill:nth=3:match=replica1",
+        sup_kwargs=dict(standby=1))
+    handles = [router.submit(Request(prompt=p, max_new_tokens=6))
+               for p in PROMPTS]
+    router.run_until_drained(max_steps=10000)
+    for _ in range(500):
+        if len(sup.recovery_log) >= 2:
+            break
+        router.step()
+    for p, h in zip(PROMPTS, handles):
+        assert h.finish_reason == "length"
+        assert h.tokens == solo_greedy(params, cfg, p, 6)
+        assert streamed[h.request_id] == h.tokens
+    paths = {r["replica"]: r["path"] for r in sup.recovery_log}
+    assert paths == {"replica0": "standby", "replica1": "cold"}
+    assert "standby pool exhausted" in capsys.readouterr().err
+    assert sup.replica_by_name("replica1").last_spawn_path == "cold"
+    # the post-crash backfill restocked the pool for the NEXT fault
+    assert sup.standby_pool.available() == 1
+
+
+def _decode_src(router, sup):
+    """Step until some worker holds a request past prefill (the draft
+    lane is primed only then — that's the state worth migrating)."""
+    for _ in range(500):
+        router.step()
+        for rep in sup.replicas:
+            for wh in rep.backend.worker.server.unfinished():
+                if not wh.prefilling:
+                    return rep
+    return None
+
+
+def test_migrated_spec_request_resumes_without_draft_prefill(cfg_params):
+    """Speculative-state-complete migration: the draft-pool rows ride
+    the transfer channel next to the target rows, and a bucket-aligned
+    prompt re-primes on the peer with ZERO draft prefill calls — the
+    whole primed cache shipped (the draft ladder has no ``-1``: drafts
+    never regenerate prompt logits)."""
+    cfg, params = cfg_params
+    router, sup, streamed = make_procfleet(
+        cfg_params,
+        server_kwargs=dict(draft_params=params, draft_cfg=cfg, spec_k=3,
+                           prefill_chunk=4, prefill_buckets=(8, 16, 32)))
+    prompt = list(range(1, 9))  # 8 tokens: exactly a ladder bucket
+    h = router.submit(Request(prompt=prompt, max_new_tokens=6))
+    src = _decode_src(router, sup)
+    assert src is not None, "request never observed mid-decode"
+    report = router.migrate_and_drain(src.name)
+    assert report["outcome"] == "ok"
+    assert report["draft_rows_installed"] >= 1
+    dst = sup.replica_by_name(report["to"])
+    spec_dec = dst.backend.worker.server.spec
+    assert spec_dec.pending_draft  # parked until the re-prime
+    prefills = []
+    orig = spec_dec.draft.engine.prefill_chunk_call
+    spec_dec.draft.engine.prefill_chunk_call = (
+        lambda *a, **kw: prefills.append(a) or orig(*a, **kw))
+    router.run_until_drained(max_steps=5000)
+    assert h.finish_reason == "length"
+    assert h.tokens == solo_greedy(params, cfg, prompt, 6)
+    assert streamed[h.request_id] == h.tokens
+    assert spec_dec.prime_adopted == 1
+    assert prefills == [], "peer re-prefilled the draft lane"
+    assert not spec_dec.pending_draft  # consumed by the prime
+
+
+def test_migrated_draft_rows_stay_head_sharded_tp2(cfg_params):
+    """Under tp=2 the parked draft rows are re-placed under the draft
+    pool's kv_sharding at adoption — heads split across the mesh, never
+    gathered — and the adopted prime still decodes token-exact."""
+    cfg, params = cfg_params
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices (conftest forces 8)")
+    mesh = make_mesh(MeshConfig(tp=2), devices=jax.devices()[:2])
+    router, sup, _ = make_procfleet(
+        cfg_params,
+        server_kwargs=dict(mesh=mesh, draft_params=params, draft_cfg=cfg,
+                           spec_k=3, prefill_chunk=4,
+                           prefill_buckets=(8, 16, 32)))
+    # max_new leaves a decode round AFTER the prefill-completion round
+    # (a k=3 spec round can retire 4 tokens at once), so a mid-decode
+    # migration window is observable
+    prompt = list(range(1, 9))
+    h = router.submit(Request(prompt=prompt, max_new_tokens=6))
+    src = _decode_src(router, sup)
+    assert src is not None, "request never observed mid-decode"
+    report = router.migrate_and_drain(src.name)
+    assert report["outcome"] == "ok"
+    assert report["draft_rows_installed"] >= 1
+    spec_dec = sup.replica_by_name(
+        report["to"]).backend.worker.server.spec
+    assert spec_dec.pending_draft
+    for key, (dk, dv) in spec_dec.pending_draft.items():
+        assert list(key) == prompt[:len(key)]
+        for arr in (dk, dv):
+            shard = arr.sharding.shard_shape(arr.shape)
+            assert shard[3] * 2 == arr.shape[3], (
+                f"parked draft rows not head-sharded: "
+                f"{arr.shape} -> {shard}")
+    router.run_until_drained(max_steps=5000)
+    assert h.finish_reason == "length"
+    assert h.tokens == solo_greedy(params, cfg, prompt, 6)
+    assert spec_dec.prime_adopted >= 1

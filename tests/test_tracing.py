@@ -274,6 +274,30 @@ def test_slo_spec_parse_and_grading():
     assert report["grade"] == "n/a"  # nothing evaluable: no letter grade
 
 
+def test_recovery_tail_slo_metric():
+    """ISSUE 17: ``recovery_pNN`` pools the per-request fault->first-
+    replacement-token scalars; requests a crash never touched carry no
+    sample and don't dilute the tail."""
+    objs = parse_slo_spec("recovery_p99<=0.5")
+    assert objs[0].metric == "recovery_p99"
+    with pytest.raises(ValueError):
+        parse_slo_spec("recovery_p999<=1")
+    requests = [
+        {"outcome": "length", "ttft_s": 0.1, "itl_s": [],
+         "recovery_s": 0.2},
+        {"outcome": "length", "ttft_s": 0.1, "itl_s": [],
+         "recovery_s": 0.9},
+        {"outcome": "length", "ttft_s": 0.1, "itl_s": []},  # undisturbed
+    ]
+    report = evaluate_slos(requests, objs)
+    row = report["objectives"][0]
+    assert row["observed"] == pytest.approx(0.9)  # exact p99 of 2 samples
+    assert row["pass"] is False
+    # nothing re-routed -> the objective is n/a, not vacuously green
+    report = evaluate_slos(requests[2:], objs)
+    assert report["objectives"][0]["pass"] is None
+
+
 # ---------------------------------------------------------------------------
 # flight recorder (pure unit)
 # ---------------------------------------------------------------------------

@@ -372,6 +372,64 @@ def test_chaos_spec_composes_and_still_validates(cfg_params):
     assert accounted == 12 and cell["completed"] > 0
 
 
+def test_recovery_tail_objective_composes_with_chaos(cfg_params):
+    """ISSUE 17: ``recovery_slo_s`` folds a ``recovery_p99`` objective
+    into the sweep's SLO spec, and a chaos run feeds it real data — the
+    crash-re-routed requests carry per-request recovery_s scalars
+    (fault observed -> first replacement token), pooled by the exact-
+    quantile engine and counted by the ``recovered`` cell key."""
+    cfg, params = cfg_params
+    spec = SweepSpec(arrival="poisson:rate=40.0", ladder=(1.0,),
+                     policies=("fifo",), n_requests=12, seed=0,
+                     n_replicas=2, n_slots=2,
+                     slo="ttft_p95<=60,error_rate<=0.5",
+                     chaos_spec="crash:nth=4:match=replica0",
+                     recovery_slo_s=30.0)
+    assert spec.effective_slo() == \
+        "ttft_p95<=60,error_rate<=0.5,recovery_p99<=30"
+    report = run_sweep(params, cfg, spec,
+                       mix=traffic_cli.selftest_mix())
+    assert validate_traffic_report(json.loads(dump_report(report)),
+                                   strict=False) == []
+    assert report["slo_spec"] == spec.effective_slo()
+    cell = report["rungs"][0]["policies"]["fifo"]
+    assert cell["recovered"] >= 1
+    row = next(r for r in cell["slo"]["objectives"]
+               if r["name"] == "recovery_p99")
+    assert row["observed"] is not None and row["observed"] > 0
+    # virtual-clock failover is fast; a 30s budget must grade PASS
+    assert row["pass"] is True
+
+
+def test_recovery_objective_without_chaos_has_no_data(cfg_params):
+    """No faults -> no request carries recovery_s -> the objective is
+    reported but excluded from the grade (never a vacuous PASS)."""
+    cfg, params = cfg_params
+    spec = SweepSpec(arrival="poisson:rate=40.0", ladder=(1.0,),
+                     policies=("fifo",), n_requests=6, seed=0,
+                     n_replicas=2, n_slots=2,
+                     slo="ttft_p95<=60", recovery_slo_s=1.0)
+    report = run_sweep(params, cfg, spec,
+                       mix=traffic_cli.selftest_mix())
+    cell = report["rungs"][0]["policies"]["fifo"]
+    assert cell["recovered"] == 0
+    row = next(r for r in cell["slo"]["objectives"]
+               if r["name"] == "recovery_p99")
+    assert row["observed"] is None and row["pass"] is None
+
+
+def test_sweep_spec_recovery_validation():
+    with pytest.raises(ValueError):
+        SweepSpec(recovery_slo_s=0.0).validate()
+    with pytest.raises(ValueError):
+        SweepSpec(recovery_slo_s=-1.0).validate()
+    SweepSpec(recovery_slo_s=0.5).validate()
+    # unset: the spec's own SLO string passes through untouched
+    assert SweepSpec().effective_slo() == SweepSpec().slo
+    assert "recovery_p99<=0.5" in \
+        SweepSpec(recovery_slo_s=0.5).effective_slo()
+
+
 def test_validator_rejects_tampered_reports(sweep_report):
     good = json.loads(dump_report(sweep_report))
     assert validate_traffic_report(good, strict=False) == []
