@@ -1135,6 +1135,57 @@ def serving_probe() -> dict:
     full_ms = prefill_ms(cfg.block_size)  # full-window prompt
     tail_ms = prefill_ms(16, offset=48)  # what a 48-row prefix hit leaves
 
+    # quantized block (ISSUE 18): an int8 twin of the same engine
+    # geometry — bytes-per-slot (payload + fp32 scale planes) against
+    # the fp32 pool, max admissible slots under a fixed synthetic
+    # per-device HBM budget (the slots-per-chip multiplier headline,
+    # asserted strictly higher at int8), and the timed compiled decode
+    # step at each dtype. Accuracy lives in serve.py --selftest-quant;
+    # this block records the capacity arithmetic perf_diff watches.
+    from mingpt_distributed_tpu.serving import quant as quant_lib
+    from mingpt_distributed_tpu.serving.engine import DecodeEngine
+
+    q_eng = DecodeEngine(
+        params, cfg, n_slots=4, prefill_buckets=(16, 32, 64, 128),
+        kv_dtype="int8",
+    )
+
+    def decode_step_ms(e) -> float:
+        n = e.n_slots
+        zeros = np.zeros(n, np.int32)
+        step = lambda: e.decode_step(  # noqa: E731
+            zeros, zeros, np.ones(n, np.float32), zeros,
+            np.ones(n, np.float32), np.zeros(n, bool),
+            jax.random.split(jax.random.key(2), n))
+        step()  # compile
+        t0 = time.perf_counter()
+        for _ in range(20):
+            step()
+        return (time.perf_counter() - t0) / 20 * 1e3
+
+    fp32_slot = sum(
+        int(a.nbytes) for a in eng.pool.cache.values()) // eng.n_slots
+    q_data, q_scales = quant_lib.split_scales(q_eng.pool.cache)
+    int8_slot = (sum(int(a.nbytes) for a in q_data.values())
+                 + sum(int(a.nbytes) for a in q_scales.values())
+                 ) // q_eng.n_slots
+    hbm_budget = 64 * 1024 * 1024  # synthetic per-device KV budget
+    max_slots_fp32 = hbm_budget // fp32_slot
+    max_slots_int8 = hbm_budget // int8_slot
+    assert max_slots_int8 > max_slots_fp32, \
+        "int8 KV pool must admit strictly more slots than fp32"
+    quantized = {
+        "kv_dtype": "int8",
+        "bytes_per_slot_fp32": fp32_slot,
+        "bytes_per_slot_int8": int8_slot,
+        "bytes_ratio": round(int8_slot / fp32_slot, 4),
+        "hbm_budget_mb": hbm_budget // (1024 * 1024),
+        "max_slots_fp32": max_slots_fp32,
+        "max_slots_int8": max_slots_int8,
+        "decode_step_fp32_ms": round(decode_step_ms(eng), 3),
+        "decode_step_int8_ms": round(decode_step_ms(q_eng), 3),
+    }
+
     slo = telemetry.evaluate_slos(
         recorder.completed_requests(),
         telemetry.parse_slo_spec(SERVING_SLO_SPEC))
@@ -1154,6 +1205,7 @@ def serving_probe() -> dict:
         "prefill_prefix_tail_ms": round(tail_ms, 2),
         "short_vs_full_speedup": round(full_ms / short_ms, 2),
         "speculative": speculative,
+        "quantized": quantized,
         "slo": slo,
     }
 

@@ -236,7 +236,10 @@ def donated_alias_count(hlo_text: str) -> int:
 def _kv_output_shardings(output_shardings: Any) -> List[Tuple[str, Any]]:
     """(path, sharding) for every K/V cache leaf of a program's output
     pytree — the leaves reached through a dict key ``"k"`` or ``"v"``
-    (the ``Cache`` container every pool/prefix program returns)."""
+    (the ``Cache`` container every pool/prefix program returns), plus
+    the ``k_scale``/``v_scale`` planes a quantized pool carries (their
+    sharded axis is kv_heads too, so the same authored sharding must
+    hold — a scale plane that gathered would silently replicate)."""
     import jax  # lazy: parsing-only callers never need a backend
 
     flat = jax.tree_util.tree_flatten_with_path(output_shardings)[0]
@@ -244,7 +247,7 @@ def _kv_output_shardings(output_shardings: Any) -> List[Tuple[str, Any]]:
     for path, shard in flat:
         keys = [p.key for p in path
                 if isinstance(p, jax.tree_util.DictKey)]
-        if any(k in ("k", "v") for k in keys):
+        if any(k in ("k", "v", "k_scale", "v_scale") for k in keys):
             out.append((jax.tree_util.keystr(path), shard))
     return out
 

@@ -49,6 +49,7 @@ tests/test_fleet.py) and driven end-to-end by ``serve.py`` at the repo
 root.
 """
 
+from mingpt_distributed_tpu.serving import quant
 from mingpt_distributed_tpu.serving.admission import AdmissionPolicy, FifoPolicy
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.fleet import (
@@ -108,4 +109,5 @@ __all__ = [
     "default_server_factory",
     "loopback_backend_factory",
     "process_backend_factory",
+    "quant",
 ]

@@ -74,6 +74,7 @@ import numpy as np
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.models import generate as gen
 from mingpt_distributed_tpu.parallel import mesh as mesh_lib
+from mingpt_distributed_tpu.serving import quant as quant_lib
 from mingpt_distributed_tpu.serving.kv_pool import PrefixKVStore, SlotKVPool
 
 #: smallest default bucket — prompts below this pay one 64-token forward,
@@ -98,16 +99,19 @@ def kv_pool_spec(tp_axis: str = "tp"):
 
 
 def _pin_kv(cache, kv_sharding):
-    """``with_sharding_constraint`` over a ``{"k","v"}`` cache (or prefix
-    entry) pytree. ``kv_sharding`` reaches every program as a
-    partial-bound constant — trace-time static, exactly like ``cfg`` —
-    which is how the mesh participates in the compile key without adding
-    executables. ``None`` (single-device engine) is the identity."""
+    """``with_sharding_constraint`` over a cache (or prefix entry)
+    pytree — ``{"k","v"}``, plus the ``*_scale`` planes of a quantized
+    pool, which carry the same head-sharding spec (their sharded axis is
+    kv_heads; the collapsed head_dim axis is unsharded either way).
+    ``kv_sharding`` reaches every program as a partial-bound constant —
+    trace-time static, exactly like ``cfg`` — which is how the mesh
+    participates in the compile key without adding executables. ``None``
+    (single-device engine) is the identity."""
     if kv_sharding is None:
         return cache
     return {
         name: jax.lax.with_sharding_constraint(cache[name], kv_sharding)
-        for name in ("k", "v")
+        for name in sorted(cache)
     }
 
 
@@ -176,36 +180,60 @@ def _select_next_slots(
 
 
 def _slot_lane(cache, slot):
-    """The (L, 1, S, KV, hd) cache lane of one slot."""
-    l, _, s, kv, hd = cache["k"].shape
-    return {
-        name: jax.lax.dynamic_slice(
-            cache[name], (0, slot, 0, 0, 0), (l, 1, s, kv, hd))
-        for name in ("k", "v")
-    }
+    """The (L, 1, S, KV, hd) cache lane of one slot (scale planes, when
+    present, slice the same way with their collapsed trailing axis)."""
+    out = {}
+    for name in sorted(cache):
+        l, _, s, kv, last = cache[name].shape
+        out[name] = jax.lax.dynamic_slice(
+            cache[name], (0, slot, 0, 0, 0), (l, 1, s, kv, last))
+    return out
 
 
 def _install_lane(cache, lane, slot):
     return {
         name: jax.lax.dynamic_update_slice(
             cache[name], lane[name], (0, slot, 0, 0, 0))
-        for name in ("k", "v")
+        for name in sorted(cache)
     }
+
+
+def _dequant_lane(lane, kv_quant, cfg):
+    """Quantized lane -> the fp32 ``{"k","v"}`` lane the shared forward
+    blocks consume; identity when the engine stores fp32. Static branch:
+    ``kv_quant`` is partial-bound, never traced."""
+    if kv_quant is None:
+        return lane
+    return quant_lib.dequantize_lane(lane, jnp.dtype(cfg.dtype))
+
+
+def _requant_lane(lane, kv_quant):
+    """The write-back half: requantize a forwarded lane before it
+    re-enters the pool. Power-of-two scales make this exactly idempotent
+    on rows the forward did not touch (serving/quant.py), which is what
+    keeps greedy decode deterministic and migrated rows bit-stable."""
+    if kv_quant is None:
+        return lane
+    return quant_lib.quantize_lane(lane, kv_quant)
 
 
 def _prefill_impl(
     params, cache, chunk, length, offset, slot,
     temp, top_k, top_p, do_sample, key,
-    *, cfg: GPTConfig, kv_sharding=None,
+    *, cfg: GPTConfig, kv_sharding=None, kv_quant=None,
 ):
     """chunk: (bucket,) right-padded tokens; length/offset/slot traced
     scalars. Forwards the chunk at absolute position ``offset`` against
     the slot's cache lane (attending everything written before it) and
     writes the lane back. Returns (token sampled at within-chunk position
     ``length - 1`` (scalar int32), updated pool cache) — the caller only
-    uses the token on the final chunk of a prompt."""
-    lane = _slot_lane(cache, slot)
+    uses the token on the final chunk of a prompt. A quantized engine
+    (``kv_quant``) dequantizes the lane before the forward and
+    requantizes the whole lane after — both inside this traced program,
+    so the dtype rides the compile key and no collective is added."""
+    lane = _dequant_lane(_slot_lane(cache, slot), kv_quant, cfg)
     x, lane = gen._forward_cached_hidden(params, chunk[None], lane, offset, cfg)
+    lane = _requant_lane(lane, kv_quant)
     h_last = jax.lax.dynamic_slice_in_dim(x, length - 1, 1, axis=1)
     logits = gen._head_logits(params, h_last, cfg)[:, 0]  # (1, V)
     tok = _select_next_slots(
@@ -217,7 +245,7 @@ def _prefill_impl(
 
 def _decode_impl(
     params, cache, tokens, positions, temps, top_ks, top_ps, do_sample, keys,
-    *, cfg: GPTConfig, kv_sharding=None,
+    *, cfg: GPTConfig, kv_sharding=None, kv_quant=None,
 ):
     """One token for every slot: tokens/positions (S,), sampling arrays
     (S,), keys (S,). Returns (next tokens (S,), updated pool cache)."""
@@ -227,8 +255,10 @@ def _decode_impl(
         # re-grow the batch axis the vmap stripped so the lane is exactly
         # solo generate's (B=1, T=1) decode body
         cache_b = jax.tree.map(lambda a: a[:, None], cache_slot)
-        logits, cache_b = gen._forward_cached(
-            params, tok[None, None], cache_b, pos, cfg)
+        lane = _dequant_lane(cache_b, kv_quant, cfg)
+        logits, lane = gen._forward_cached(
+            params, tok[None, None], lane, pos, cfg)
+        cache_b = _requant_lane(lane, kv_quant)
         return logits[0], jax.tree.map(lambda a: a[:, 0], cache_b)
 
     logits, cache = jax.vmap(one_slot, in_axes=(0, 1, 0), out_axes=(0, 1))(
@@ -243,24 +273,26 @@ def _extract_prefix_impl(cache, slot, *, rows: int, kv_sharding=None):
     (one trace per bucket-quantized prefix length). The entry keeps the
     pool's head-sharding (same spec, smaller row count), so storing a
     prefix never gathers K/V to one chip."""
-    l, _, _, kv, hd = cache["k"].shape
-    return _pin_kv({
-        name: jax.lax.dynamic_slice(
-            cache[name], (0, slot, 0, 0, 0), (l, 1, rows, kv, hd))
-        for name in ("k", "v")
-    }, kv_sharding)
+    out = {}
+    for name in sorted(cache):
+        l, _, _, kv, last = cache[name].shape
+        out[name] = jax.lax.dynamic_slice(
+            cache[name], (0, slot, 0, 0, 0), (l, 1, rows, kv, last))
+    return _pin_kv(out, kv_sharding)
 
 
-def _install_prefix_impl(cache, entry_k, entry_v, slot, *, kv_sharding=None):
-    """Write a stored (L, 1, P, KV, hd) prefix entry into rows [0, P) of a
+def _install_prefix_impl(cache, entry, slot, *, kv_sharding=None):
+    """Write a stored (L, 1, P, KV, hd) prefix entry (a lane dict: K/V
+    payloads plus scale planes on a quantized pool) into rows [0, P) of a
     slot lane — a device-side dynamic_update_slice, no recompute. Entry
     and pool carry the same head-sharding, so a hit is a chip-local row
-    copy."""
+    copy. For the fp32 ``{"k","v"}`` entry this flattens to the identical
+    two-leaf program as before the quantization layer existed."""
     return _pin_kv({
-        "k": jax.lax.dynamic_update_slice(
-            cache["k"], entry_k.astype(cache["k"].dtype), (0, slot, 0, 0, 0)),
-        "v": jax.lax.dynamic_update_slice(
-            cache["v"], entry_v.astype(cache["v"].dtype), (0, slot, 0, 0, 0)),
+        name: jax.lax.dynamic_update_slice(
+            cache[name], entry[name].astype(cache[name].dtype),
+            (0, slot, 0, 0, 0))
+        for name in sorted(cache)
     }, kv_sharding)
 
 
@@ -286,10 +318,21 @@ class DecodeEngine:
         prefix_cache_mb: float = 0.0,
         mesh: Optional[jax.sharding.Mesh] = None,
         tp_axis: str = "tp",
+        kv_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
         self.mesh = mesh
         self.tp_axis = tp_axis
+        # ISSUE 18: "fp32" (default; byte-identical to the pre-quant
+        # engine), "int8", or "fp8" (where the backend dtype exists).
+        # Resolved once; the KVQuant descriptor is partial-bound into the
+        # program families below, so the dtype IS part of each compile key.
+        self.kv_quant = quant_lib.resolve_kv_dtype(kv_dtype)
+        self.kv_dtype = "fp32" if self.kv_quant is None else self.kv_quant.name
+        if self.kv_quant is not None and cache_dtype is not None:
+            raise ValueError(
+                "cache_dtype and kv_dtype are mutually exclusive — a "
+                "quantized pool's storage dtype comes from kv_dtype")
         if mesh is not None:
             # One placement decision, made once: params follow the megatron
             # column/row rules, the pool shards heads over the tp axis (or
@@ -321,7 +364,8 @@ class DecodeEngine:
         self.buckets = bucket_ladder(
             self.prefill_len, prefill_buckets, prefill_chunk)
         self.pool = SlotKVPool(
-            cfg, n_slots, cache_dtype, sharding=self.kv_sharding)
+            cfg, n_slots, cache_dtype, sharding=self.kv_sharding,
+            quant=self.kv_quant)
         # the pool normalizes the sharding to the runtime's canonical
         # form; the programs must bind THAT object, or executable keys
         # (which compare shardings) would treat warmup inputs and
@@ -335,11 +379,14 @@ class DecodeEngine:
         # mesh is compile identity, not a traced input, so each family
         # still owns exactly one jit wrapper (and one executable).
         kv = self.kv_sharding
+        kq = self.kv_quant
         self._prefill_jit = jax.jit(
-            functools.partial(_prefill_impl, cfg=cfg, kv_sharding=kv),
+            functools.partial(
+                _prefill_impl, cfg=cfg, kv_sharding=kv, kv_quant=kq),
             donate_argnums=(1,))
         self._decode_jit = jax.jit(
-            functools.partial(_decode_impl, cfg=cfg, kv_sharding=kv),
+            functools.partial(
+                _decode_impl, cfg=cfg, kv_sharding=kv, kv_quant=kq),
             donate_argnums=(1,))
         # prefix copy programs: `rows` is static, so one jit wrapper traces
         # once per bucket-quantized prefix length
@@ -429,12 +476,12 @@ class DecodeEngine:
         of rows installed (0 = miss / store disabled)."""
         if self.prefix_store is None:
             return 0
-        entry = self.prefix_store.lookup(tuple(prompt_ids))
-        if entry is None:
+        hit = self.prefix_store.lookup(tuple(prompt_ids))
+        if hit is None:
             return 0
-        rows, (ek, ev) = entry
+        rows, entry = hit
         self.pool.cache = self._install_jit(
-            self.pool.cache, ek, ev, np.int32(slot))
+            self.pool.cache, entry, np.int32(slot))
         return rows
 
     def save_prefix(self, slot: int, prompt_ids: Sequence[int]) -> int:
@@ -450,7 +497,7 @@ class DecodeEngine:
         if self.prefix_store.contains(key):
             return 0
         lane = self._extract_jit(self.pool.cache, np.int32(slot), rows=rows)
-        stored = self.prefix_store.insert(key, (lane["k"], lane["v"]))
+        stored = self.prefix_store.insert(key, lane)
         return rows if stored else 0
 
     # -- live migration (ISSUE 16) -------------------------------------
@@ -470,61 +517,59 @@ class DecodeEngine:
                 best = b
         return best
 
-    def extract_slot_rows(self, slot: int, rows: int):
+    def _place_entry(self, entry: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """Re-place a lane/entry dict (possibly host arrays off the
+        transfer channel) under the pool's sharding so adopted rows stay
+        head-sharded on device exactly like locally-extracted ones."""
+        if self.kv_sharding is not None:
+            return {n: jax.device_put(a, self.kv_sharding)
+                    for n, a in entry.items()}
+        return {n: jnp.asarray(a) for n, a in entry.items()}
+
+    def extract_slot_rows(self, slot: int, rows: int) -> Dict[str, jax.Array]:
         """Pull ``rows`` leading K/V rows out of ``slot`` as a pinned
-        (L, 1, rows, KV, hd) entry — the extract half of live migration,
-        through the SAME row-copy program family ``save_prefix`` uses.
-        ``rows`` must sit on the bucket ladder so this never grows the
-        bounded prefix-copy family past one trace per bucket."""
+        (L, 1, rows, KV, hd) entry dict (payloads + scale planes on a
+        quantized engine — a migrated quantized entry ships ~4x fewer
+        bytes) — the extract half of live migration, through the SAME
+        row-copy program family ``save_prefix`` uses. ``rows`` must sit
+        on the bucket ladder so this never grows the bounded prefix-copy
+        family past one trace per bucket."""
         if rows not in self.buckets:
             raise ValueError(
                 f"extract rows {rows} not on the bucket ladder "
                 f"{self.buckets} — migration must reuse the compiled "
                 f"prefix-copy programs, not mint new ones")
-        lane = self._extract_jit(self.pool.cache, np.int32(slot), rows=rows)
-        return lane["k"], lane["v"]
+        return self._extract_jit(self.pool.cache, np.int32(slot), rows=rows)
 
-    def install_slot_rows(self, slot: int, k, v) -> int:
-        """Copy an extracted (L, 1, rows, KV, hd) K/V entry straight into
-        ``slot``'s leading cache rows — the install half of live
+    def install_slot_rows(self, slot: int, entry: Dict[str, jax.Array]) -> int:
+        """Copy an extracted (L, 1, rows, KV, hd) entry dict straight
+        into ``slot``'s leading cache rows — the install half of live
         migration for engines that have no prefix store (the draft
-        engine): same compiled row-copy program ``try_load_prefix``
-        uses, re-placed under the pool's sharding first so adopted rows
-        stay head-sharded. Returns the rows installed."""
-        rows = int(k.shape[2])
+        engine): same compiled row-copy program ``try_load_prefix`` uses.
+        Returns the rows installed."""
+        rows = int(entry["k"].shape[2])
         if rows not in self.buckets:
             raise ValueError(
                 f"install rows {rows} not on the bucket ladder "
                 f"{self.buckets} — migration must reuse the compiled "
                 f"prefix-copy programs, not mint new ones")
-        if self.kv_sharding is not None:
-            k = jax.device_put(k, self.kv_sharding)
-            v = jax.device_put(v, self.kv_sharding)
-        else:
-            k = jnp.asarray(k)
-            v = jnp.asarray(v)
         self.pool.cache = self._install_jit(
-            self.pool.cache, k, v, np.int32(slot))
+            self.pool.cache, self._place_entry(entry), np.int32(slot))
         return rows
 
-    def adopt_prefix_entry(self, key: Sequence[int], k, v) -> bool:
-        """Install a migrated prefix entry (host arrays off the transfer
-        channel) into THIS engine's prefix store, re-placed under the
-        pool's sharding so entries stay head-sharded on device exactly
-        like locally-saved ones. Returns False when the store is
+    def adopt_prefix_entry(self, key: Sequence[int],
+                           entry: Dict[str, jax.Array]) -> bool:
+        """Install a migrated prefix entry dict (host arrays off the
+        transfer channel) into THIS engine's prefix store, re-placed
+        under the pool's sharding so entries stay head-sharded on device
+        exactly like locally-saved ones. Returns False when the store is
         disabled, full, or already holds the key."""
         if self.prefix_store is None:
             return False
         key = tuple(int(t) for t in key)
         if self.prefix_store.contains(key):
             return False
-        if self.kv_sharding is not None:
-            k = jax.device_put(k, self.kv_sharding)
-            v = jax.device_put(v, self.kv_sharding)
-        else:
-            k = jnp.asarray(k)
-            v = jnp.asarray(v)
-        return self.prefix_store.insert(key, (k, v))
+        return self.prefix_store.insert(key, self._place_entry(entry))
 
     # -- warmup --------------------------------------------------------
     def warmup(self) -> None:
@@ -552,7 +597,7 @@ class DecodeEngine:
                     lane = self._extract_jit(
                         self.pool.cache, np.int32(0), rows=b)
                     self.pool.cache = self._install_jit(
-                        self.pool.cache, lane["k"], lane["v"], np.int32(0))
+                        self.pool.cache, lane, np.int32(0))
 
     def decode_step(
         self,
@@ -618,8 +663,6 @@ class DecodeEngine:
              jnp.stack([key] * s)),
             clock)
         if self.prefix_store is not None:
-            l, _, _, kv, hd = self.pool.cache["k"].shape
-            dt = self.pool.cache["k"].dtype
             for b in self.buckets:
                 if b > self.prefill_len - 1:
                     continue
@@ -627,10 +670,14 @@ class DecodeEngine:
                     family_prefix + "prefix_save", self._extract_jit,
                     (self.pool.cache, np.int32(0)),
                     clock, variant=f"b{b}", kwargs={"rows": b})
-                entry = jax.ShapeDtypeStruct((l, 1, b, kv, hd), dt)
+                entry = {}
+                for name, arr in self.pool.cache.items():
+                    l, _, _, kv, last = arr.shape
+                    entry[name] = jax.ShapeDtypeStruct(
+                        (l, 1, b, kv, last), arr.dtype)
                 ledger.register_aot(
                     family_prefix + "prefix_load", self._install_jit,
-                    (self.pool.cache, entry, entry, np.int32(0)),
+                    (self.pool.cache, entry, np.int32(0)),
                     clock, variant=f"b{b}")
 
     # -- static audit contracts (ISSUE 15) -----------------------------
@@ -645,9 +692,10 @@ class DecodeEngine:
           small per-token activations (``all-gather``); the prefix copy
           programs are chip-local row moves and allow nothing, at any tp.
         * ``donated`` — exact ``input_output_alias`` entry count the
-          executable must carry: 2 (the donated cache's k and v leaves)
-          for prefill/decode/prefix_load, 0 for prefix_save (extract
-          donates nothing — the pool must survive the read).
+          executable must carry: one per donated cache leaf (2 on an
+          fp32 pool — k and v; 4 on a quantized pool — the scale planes
+          alias too) for prefill/decode/prefix_load, 0 for prefix_save
+          (extract donates nothing — the pool must survive the read).
         * ``kv_output_sharding`` — the normalized NamedSharding every
           returned cache/entry leaf must carry (None = single device).
         * ``pool_leaf_elems`` — element count of one K/V pool buffer; a
@@ -660,7 +708,7 @@ class DecodeEngine:
         model = {
             "allowed_collectives":
                 ("all-gather", "all-reduce") if tp > 1 else (),
-            "donated": 2,
+            "donated": len(self.pool.cache),
             "kv_output_sharding": self.kv_sharding,
             "pool_leaf_elems": facts["cache_leaf_elems"],
         }

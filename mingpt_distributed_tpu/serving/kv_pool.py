@@ -41,6 +41,7 @@ from typing import List, Optional, Tuple
 
 from mingpt_distributed_tpu.config import GPTConfig
 from mingpt_distributed_tpu.models.generate import Cache, init_cache
+from mingpt_distributed_tpu.serving import quant as quant_lib
 
 
 class SlotKVPool:
@@ -52,17 +53,24 @@ class SlotKVPool:
     """
 
     def __init__(self, cfg: GPTConfig, n_slots: int, dtype=None,
-                 sharding=None):
+                 sharding=None, quant=None):
         if n_slots < 1:
             raise ValueError(f"n_slots must be >= 1, got {n_slots}")
         self.cfg = cfg
         self.n_slots = n_slots
-        cache: Cache = init_cache(cfg, n_slots, dtype)
+        self.quant = quant
+        if quant is None:
+            cache: Cache = init_cache(cfg, n_slots, dtype)
+        else:
+            # quantized payload buffers + fp32 scale planes (ISSUE 18);
+            # the scale leaves are rank-5 with head_dim -> 1, so the
+            # head-sharding spec below applies to them unchanged
+            cache = quant_lib.init_quant_cache(cfg, n_slots, quant)
         if sharding is not None:
             import jax
 
             cache = jax.device_put(
-                cache, {"k": sharding, "v": sharding})
+                cache, {name: sharding for name in cache})
             # adopt the runtime's normalized sharding (trailing-None
             # PartitionSpec entries stripped): compiled-program outputs
             # carry the normalized form, and the engine keys executables
@@ -128,8 +136,11 @@ class PrefixKVStore:
 
     Keys are exact token tuples (the prefix the rows encode — hashing the
     tokens themselves, so a hit can never alias two different prefixes);
-    values are device-array ``(k, v)`` pairs of shape (L, 1, P, KV, hd)
-    with P = len(key). ``capacity_bytes`` bounds the sum of entry sizes;
+    values are device-array lane dicts (``{"k", "v"}``, plus
+    ``{"k_scale", "v_scale"}`` planes when the pool is quantized) of
+    shape (L, 1, P, KV, hd) with P = len(key). ``capacity_bytes`` bounds
+    the sum of entry sizes across every leaf — a quantized store fits
+    ~4x the prefixes in the same budget, which is the ISSUE 18 point;
     inserting past it evicts least-recently-used entries first. An entry
     larger than the whole budget is refused rather than thrashing the
     store empty.
@@ -150,20 +161,21 @@ class PrefixKVStore:
         return key in self._entries
 
     def entries(self):
-        """(key, (k, v)) pairs in LRU order — read-only introspection for
-        accounting and the sharded-serving selftest (which asserts stored
-        entries keep the pool's head-sharding instead of gathering)."""
+        """(key, lane-dict) pairs in LRU order — read-only introspection
+        for accounting and the sharded-serving selftest (which asserts
+        stored entries keep the pool's head-sharding instead of
+        gathering)."""
         return list(self._entries.items())
 
     @staticmethod
     def _nbytes(kv) -> int:
-        return int(kv[0].nbytes) + int(kv[1].nbytes)
+        return sum(int(a.nbytes) for a in kv.values())
 
     def lookup(self, tokens: Tuple[int, ...]):
         """Longest stored entry that is a *proper* prefix of ``tokens``
         (P < len(tokens): the tail must keep >= 1 token to prefill, since
         the first sampled token needs the last prompt position's logits).
-        Returns (rows, (k, v)) or None; a hit refreshes LRU order."""
+        Returns (rows, lane-dict) or None; a hit refreshes LRU order."""
         best_key = None
         for key in self._entries:
             p = len(key)

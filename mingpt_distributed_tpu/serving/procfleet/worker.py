@@ -289,15 +289,23 @@ class ReplicaWorker:
         every in-flight slot, as transfer-channel frames."""
         import jax
 
-        def entry_frame(kind: str, key, k, v):
-            k_np = np.asarray(jax.device_get(k))
-            v_np = np.asarray(jax.device_get(v))
+        def entry_frame(kind: str, key, entry: Dict[str, Any]):
+            # per-leaf manifest: a quantized lane ships payload leaves +
+            # scale planes (int8 payload bytes are what make migration
+            # ~4x cheaper, ISSUE 18); an fp32 lane ships just k/v. The
+            # payload is each leaf's raw bytes concatenated in manifest
+            # order.
+            leaves = []
+            blobs = []
+            for name in sorted(entry):
+                arr = np.asarray(jax.device_get(entry[name]))
+                leaves.append({"name": name, "dtype": str(arr.dtype),
+                               "shape": list(arr.shape),
+                               "nbytes": int(arr.nbytes)})
+                blobs.append(arr.tobytes())
             meta = {"type": kind, "key": [int(t) for t in key],
-                    "dtype": str(k_np.dtype),
-                    "k_shape": list(k_np.shape),
-                    "v_shape": list(v_np.shape),
-                    "k_nbytes": int(k_np.nbytes)}
-            return meta, k_np.tobytes() + v_np.tobytes()
+                    "leaves": leaves}
+            return meta, b"".join(blobs)
 
         eng = self.server.engine
         spec_dec = getattr(self.server, "spec", None)
@@ -305,8 +313,8 @@ class ReplicaWorker:
         shipped = set()
         draft_shipped = set()
         if eng.prefix_store is not None:
-            for key, (k, v) in eng.prefix_store.entries():
-                frames.append(entry_frame("prefix_entry", key, k, v))
+            for key, entry in eng.prefix_store.entries():
+                frames.append(entry_frame("prefix_entry", key, entry))
                 shipped.add(tuple(key))
         for h in self.server.slots.live_handles():
             if h.finished or h.slot is None:
@@ -317,8 +325,8 @@ class ReplicaWorker:
             if rows > 0:
                 key = tuple(int(t) for t in h.prompt_used[:rows])
                 if key not in shipped:
-                    k, v = eng.extract_slot_rows(h.slot, rows)
-                    frames.append(entry_frame("slot_rows", key, k, v))
+                    entry = eng.extract_slot_rows(h.slot, rows)
+                    frames.append(entry_frame("slot_rows", key, entry))
                     shipped.add(key)
             if spec_dec is None or h.prefilling:
                 continue
@@ -333,8 +341,8 @@ class ReplicaWorker:
             dkey = tuple(int(t) for t in h.prompt_used[:drows])
             if dkey in draft_shipped:
                 continue
-            dk, dv = spec_dec.extract_draft_rows(h.slot, drows)
-            frames.append(entry_frame("draft_rows", dkey, dk, dv))
+            dentry = spec_dec.extract_draft_rows(h.slot, drows)
+            frames.append(entry_frame("draft_rows", dkey, dentry))
             draft_shipped.add(dkey)
         manifest = {
             "type": "manifest", "replica": self.name,
@@ -365,21 +373,24 @@ class ReplicaWorker:
                 if kind not in ("prefix_entry", "slot_rows", "draft_rows"):
                     return _error(400, "bad_frames",
                                   f"unknown frame type {kind!r}")
-                kn = int(meta["k_nbytes"])
-                dt = np.dtype(meta["dtype"])
-                k = np.frombuffer(payload[:kn], dtype=dt).reshape(
-                    meta["k_shape"])
-                v = np.frombuffer(payload[kn:], dtype=dt).reshape(
-                    meta["v_shape"])
+                entry: Dict[str, Any] = {}
+                off = 0
+                for leaf in meta["leaves"]:
+                    n = int(leaf["nbytes"])
+                    entry[leaf["name"]] = np.frombuffer(
+                        payload[off:off + n],
+                        dtype=np.dtype(leaf["dtype"]),
+                    ).reshape(leaf["shape"])
+                    off += n
                 if kind == "draft_rows":
                     # parked for SpeculativeDecoder.prime; a peer
                     # without speculation skips — degrade, never fail
                     if spec_dec is not None and spec_dec.adopt_draft_rows(
-                            tuple(meta["key"]), k, v):
+                            tuple(meta["key"]), entry):
                         draft_installed += 1
                     else:
                         skipped += 1
-                elif eng.adopt_prefix_entry(meta["key"], k, v):
+                elif eng.adopt_prefix_entry(meta["key"], entry):
                     installed += 1
                 else:
                     skipped += 1

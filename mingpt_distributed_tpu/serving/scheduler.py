@@ -86,6 +86,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from mingpt_distributed_tpu.config import GPTConfig
+from mingpt_distributed_tpu.serving import quant as quant_lib
 from mingpt_distributed_tpu.serving.admission import AdmissionPolicy, FifoPolicy
 from mingpt_distributed_tpu.serving.engine import DecodeEngine
 from mingpt_distributed_tpu.serving.metrics import ServingMetrics
@@ -218,6 +219,7 @@ class InferenceServer:
         attrib: bool = False,
         mesh=None,
         tp_axis: str = "tp",
+        kv_dtype: Optional[str] = None,
     ):
         self.cfg = cfg
         # mesh passes through untouched: the scheduler owns slots
@@ -228,7 +230,7 @@ class InferenceServer:
             params, cfg, n_slots, prefill_len,
             prefill_buckets=prefill_buckets, prefill_chunk=prefill_chunk,
             prefix_cache_mb=prefix_cache_mb,
-            mesh=mesh, tp_axis=tp_axis,
+            mesh=mesh, tp_axis=tp_axis, kv_dtype=kv_dtype,
         )
         # speculative decoding (serving/speculative.py): a draft model +
         # spec_k >= 1 turn the decode round into propose→verify→accept-n.
@@ -261,6 +263,28 @@ class InferenceServer:
             tracer=self.tracer,
             hard_fail=recompile_fail,
         )
+        # KV storage dtype as a build-info-style gauge (ISSUE 18): one
+        # labeled child set to 1, so a scrape (and the fleet-merged
+        # scrape, per-replica) states which dtype this server runs
+        # without needing a registry schema change per dtype. A second
+        # gauge carries the quantization quality number the selftest
+        # samples (max |Δlogit| of a KV round trip) — quantized servers
+        # only; the fp32 scrape is byte-identical to pre-quant builds.
+        _reg = self.metrics.registry if registry is None else registry
+        self._quant_err_gauge = None
+        if _reg is not None:
+            _reg.gauge(
+                "mingpt_serve_kv_dtype",
+                help="KV-cache storage dtype (build-info style: the "
+                     "labeled child is 1)",
+                labels=("kv_dtype",),
+            ).labels(kv_dtype=self.engine.kv_dtype).set(1)
+            if self.engine.kv_quant is not None:
+                self._quant_err_gauge = _reg.gauge(
+                    "mingpt_serve_quant_logit_err_max",
+                    help="max |logit delta| of a KV quantize/dequantize "
+                         "round trip, as sampled by the quant selftest",
+                )
         self.on_token = on_token
         if max_queue is not None and max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
@@ -329,9 +353,21 @@ class InferenceServer:
         eng = self.engine
         self.hbm.account("params", tree_bytes(eng.params),
                          per_device_bytes=per_device_tree_bytes(eng.params))
-        self.hbm.account("kv_pool", tree_bytes(eng.pool.cache),
-                         per_device_bytes=per_device_tree_bytes(
-                             eng.pool.cache))
+        if eng.kv_quant is not None:
+            # quantized pool (ISSUE 18): payload bytes stay the kv_pool
+            # owner, the fp32 scale planes get their own first-class
+            # owner so a capacity plan can see exactly what the scales
+            # cost. fp32 pools take the other branch untouched — the
+            # fp32 attrib report is byte-identical to pre-quant builds.
+            data, scales = quant_lib.split_scales(eng.pool.cache)
+            self.hbm.account("kv_pool", tree_bytes(data),
+                             per_device_bytes=per_device_tree_bytes(data))
+            self.hbm.account("kv_scales", tree_bytes(scales),
+                             per_device_bytes=per_device_tree_bytes(scales))
+        else:
+            self.hbm.account("kv_pool", tree_bytes(eng.pool.cache),
+                             per_device_bytes=per_device_tree_bytes(
+                                 eng.pool.cache))
         store = eng.prefix_store
         store_bytes = 0 if store is None else store.used_bytes
         # prefix entries carry the pool's head-sharding, so per-device
@@ -347,6 +383,14 @@ class InferenceServer:
             self.hbm.account("draft_pool", tree_bytes(de.pool.cache),
                              per_device_bytes=per_device_tree_bytes(
                                  de.pool.cache))
+
+    def observe_quant_logit_error(self, err: float) -> None:
+        """Record a sampled quantization quality number (max |Δlogit| of
+        a KV round trip, ``quant.max_abs_logit_error``) into the
+        ``mingpt_serve_quant_logit_err_max`` gauge. No-op on fp32
+        servers or when no registry is wired in."""
+        if self._quant_err_gauge is not None:
+            self._quant_err_gauge.set(float(err))
 
     def attrib_report(self, include_live: bool = False) -> Dict[str, Any]:
         """The mingpt-attrib/1 report for this server (raises when the

@@ -48,8 +48,10 @@ from ..config import GPTConfig
 from ..models import generate as gen
 from .engine import (
     DecodeEngine,
+    _dequant_lane,
     _install_lane,
     _pin_kv,
+    _requant_lane,
     _select_next_slots,
     _slot_lane,
 )
@@ -59,17 +61,20 @@ __all__ = ["DraftEngine", "SpeculativeDecoder"]
 
 def _verify_impl(
     params, cache, tokens, offset, slot, temp, top_k, top_p, key,
-    *, cfg: GPTConfig, kv_sharding=None,
+    *, cfg: GPTConfig, kv_sharding=None, kv_quant=None,
 ):
     """Score ``tokens`` (rows = k+1, static) at absolute positions
     ``offset..offset+rows-1`` against one slot lane and return the
     target's next-token choice at EVERY row. The sampler is
     ``_select_next_slots`` with the slot's own (greedy) parameters — not
     a raw argmax — so fp tie-breaking is bit-identical to the plain
-    decode path and parity holds even on tied logits."""
+    decode path and parity holds even on tied logits. A quantized pool
+    dequantizes the lane before the forward and requantizes the whole
+    lane on the way back in, same as the prefill/decode bodies."""
     rows = tokens.shape[0]
-    lane = _slot_lane(cache, slot)
+    lane = _dequant_lane(_slot_lane(cache, slot), kv_quant, cfg)
     x, lane = gen._forward_cached_hidden(params, tokens[None], lane, offset, cfg)
+    lane = _requant_lane(lane, kv_quant)
     logits = gen._head_logits(params, x, cfg)[0]  # (rows, V) fp32
     keys = jax.random.split(key, rows)
     nxt = _select_next_slots(
@@ -113,6 +118,9 @@ class DraftEngine:
             prefill_buckets=target.buckets,
             mesh=target.mesh,
             tp_axis=target.tp_axis,
+            # mirror the target's KV storage dtype (ISSUE 18): smaller
+            # draft + target caches compose into more concurrent lanes
+            kv_dtype=target.kv_dtype,
         )
 
     def bind(self, slot: int) -> None:
@@ -163,12 +171,14 @@ class SpeculativeDecoder:
         self._parked = target.cfg.block_size - 1
         self._verify_jit = jax.jit(
             functools.partial(_verify_impl, cfg=target.cfg,
-                              kv_sharding=target.kv_sharding),
+                              kv_sharding=target.kv_sharding,
+                              kv_quant=target.kv_quant),
             donate_argnums=(1,))
         # migrated draft state parked until the owning request re-primes
-        # (ISSUE 17): prompt-prefix key -> (k, v) rows, device-side under
-        # the draft pool's sharding. Bounded FIFO — advisory state only.
-        self.pending_draft: Dict[tuple, tuple] = {}
+        # (ISSUE 17): prompt-prefix key -> lane-dict rows, device-side
+        # under the draft pool's sharding. Bounded FIFO — advisory state
+        # only.
+        self.pending_draft: Dict[tuple, dict] = {}
         self.pending_draft_cap = 32
         self.prime_full = 0     # primes that paid a full draft prefill
         self.prime_adopted = 0  # primes served from migrated rows
@@ -199,8 +209,8 @@ class SpeculativeDecoder:
             # one-shot: the rows now live in the slot's cache; keeping
             # the parked copy would pin device memory for a request
             # that already resumed
-            dk, dv = self.pending_draft.pop(best)
-            rows = self.draft.engine.install_slot_rows(slot, dk, dv)
+            entry = self.pending_draft.pop(best)
+            rows = self.draft.engine.install_slot_rows(slot, entry)
             if rows < len(prompt):
                 self.draft.engine.prefill_chunk_call(
                     slot, prompt[rows:], rows, 1.0, None, None, False,
@@ -229,25 +239,20 @@ class SpeculativeDecoder:
         family as the target's, on the draft pool."""
         return self.draft.engine.extract_slot_rows(slot, rows)
 
-    def adopt_draft_rows(self, key: Sequence[int], k, v) -> bool:
-        """Park migrated draft rows (host arrays off the transfer
-        channel) until the re-routed request's ``prime``, re-placed
-        under the draft pool's sharding so adopted rows stay
-        head-sharded under tp exactly like locally-primed ones. Bounded
-        FIFO; returns False when already present."""
+    def adopt_draft_rows(self, key: Sequence[int], entry: dict) -> bool:
+        """Park a migrated draft row entry (host-array lane dict off the
+        transfer channel — quantized lanes carry their scale planes)
+        until the re-routed request's ``prime``, re-placed under the
+        draft pool's sharding so adopted rows stay head-sharded under tp
+        exactly like locally-primed ones. Bounded FIFO; returns False
+        when already present."""
         key = tuple(int(t) for t in key)
         if key in self.pending_draft:
             return False
-        eng = self.draft.engine
-        if eng.kv_sharding is not None:
-            k = jax.device_put(k, eng.kv_sharding)
-            v = jax.device_put(v, eng.kv_sharding)
-        else:
-            k = jnp.asarray(k)
-            v = jnp.asarray(v)
+        entry = self.draft.engine._place_entry(entry)
         while len(self.pending_draft) >= self.pending_draft_cap:
             self.pending_draft.pop(next(iter(self.pending_draft)))
-        self.pending_draft[key] = (k, v)
+        self.pending_draft[key] = entry
         return True
 
     # -- eligibility ---------------------------------------------------
@@ -373,30 +378,36 @@ class SpeculativeDecoder:
             "draft_decode": draft["decode"],
         }
 
-    def register_attrib(self, ledger, clock) -> None:
+    def register_attrib(self, ledger, clock,
+                        family_prefix: str = "") -> None:
         """Attribution registration (ISSUE 13): the verify program plus
         the draft engine's families under the ``draft_`` prefix —
         matching the ``compile_counts()`` family names, AOT and
-        jit-cache-neutral exactly like ``DecodeEngine.register_attrib``."""
+        jit-cache-neutral exactly like ``DecodeEngine.register_attrib``.
+        ``family_prefix`` prefixes every family (graftaudit registers a
+        quantized decoder beside the fp32 one as ``q8_*``)."""
         key = jax.random.key(0)
         ledger.register_aot(
-            "verify", self._verify_jit,
+            f"{family_prefix}verify", self._verify_jit,
             (self.target.params, self.target.pool.cache,
              jnp.zeros(self.rows, jnp.int32),
              np.int32(0), np.int32(0),
              np.float32(1.0), np.int32(0), np.float32(1.0), key),
             clock, variant=f"k{self.k}")
-        self.draft.engine.register_attrib(ledger, clock,
-                                          family_prefix="draft_")
+        self.draft.engine.register_attrib(
+            ledger, clock, family_prefix=f"{family_prefix}draft_")
 
-    def audit_contracts(self) -> Dict[str, dict]:
+    def audit_contracts(self, family_prefix: str = "") -> Dict[str, dict]:
         """Audit contracts (ISSUE 15) for the families
         ``register_attrib`` registers: verify is a model-forwarding
         family on the target engine — same collectives/donation/sharding
         contract as the target's prefill — and the draft families are
         the draft engine's own contracts under the ``draft_`` prefix."""
-        verify = dict(self.target.audit_contracts()["prefill"])
+        prefill = f"{family_prefix}prefill"
+        verify = dict(
+            self.target.audit_contracts(family_prefix=family_prefix)[prefill])
         return {
-            "verify": verify,
-            **self.draft.engine.audit_contracts(family_prefix="draft_"),
+            f"{family_prefix}verify": verify,
+            **self.draft.engine.audit_contracts(
+                family_prefix=f"{family_prefix}draft_"),
         }

@@ -116,6 +116,20 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest-spec --spec-k 3
 
+# Quantized-KV gate (ISSUE 18): an int8 KV pool (quantized payloads +
+# fp32 power-of-two scale planes) composed with chunked prefill, the
+# shared-prefix store and speculative decoding must track the fp32
+# server within the tolerance parity gate while reporting
+# kv_pool+kv_scales <= 0.27x the fp32 pool bytes in HBMLedger, with
+# compile_counts() identical per dtype, zero post-warmup recompiles,
+# the mingpt_serve_kv_dtype build-info gauge and a sampled
+# max-abs-logit-error gauge in the scrape, and the fp8 gate resolving
+# only where the backend dtype exists.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-quant
+
 # Durability gate: fault-injected checkpoint save/restore roundtrip on a
 # tmpdir — every 3rd write fails transiently (retries must absorb it) and
 # the latest blob is truncated (restore must fall back to the previous

@@ -132,6 +132,23 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--prefix-cache-mb", type=float, default=0.0,
                    help="LRU budget (MiB) for shared-prefix KV reuse; "
                         "0 disables the prefix store")
+    p.add_argument("--kv-dtype", choices=("fp32", "int8", "fp8"),
+                   default="fp32",
+                   help="KV-cache storage dtype (ISSUE 18): int8 stores "
+                        "quantized K/V payloads + fp32 scale planes "
+                        "(~0.27x the pool bytes at head_dim>=64 — ~4x "
+                        "the decode lanes per chip); fp8 needs a jax "
+                        "with float8_e4m3fn; fp32 is the byte-identical "
+                        "default path")
+    p.add_argument("--selftest-quant", action="store_true",
+                   help="ISSUE 18 gate: int8 KV pool with chunked "
+                        "prefill + prefix store + speculation composed "
+                        "— greedy token parity within tolerance vs the "
+                        "fp32 server, identical compile_counts per "
+                        "dtype, zero post-warmup recompiles, HBMLedger "
+                        "kv_pool+kv_scales <= 0.27x the fp32 bytes, and "
+                        "a sampled max-abs-logit-error gauge; then "
+                        "exits")
     p.add_argument("--warmup", action="store_true",
                    help="pre-trace the prefill bucket ladder and decode "
                         "step before serving (no first-request compile "
@@ -322,6 +339,7 @@ def _server_kwargs(args) -> dict:
         prefill_chunk=args.prefill_chunk,
         prefix_cache_mb=args.prefix_cache_mb,
         warmup=args.warmup,
+        kv_dtype=getattr(args, "kv_dtype", "fp32"),
     )
 
 
@@ -774,6 +792,162 @@ def selftest_spec(args) -> int:
           f"prefix_hits {m2.prefix_hits}, counts {counts2}")
     print("selftest-spec metrics:", json.dumps(srv2.summary()))
     print("selftest-spec", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
+def selftest_quant(args) -> int:
+    """The ISSUE 18 acceptance gate: an int8 KV pool with chunked
+    prefill + prefix store + speculation composed must track the fp32
+    server within tolerance while paying ~0.27x the pool bytes.
+
+    Geometry note: the scale planes cost 4 bytes per (row, kv_head)
+    against head_dim payload bytes, so the <= 0.27 bytes ratio needs
+    head_dim >= 64 — this gate runs n_embd=256 / n_head=4 (head_dim 64)
+    rather than the other selftests' head_dim-16 tiny config.
+
+    Checks: greedy token parity within tolerance (>= 90% of emitted
+    tokens on the common prefix per request, across chunked prefill,
+    prefix hits and speculative bursts); ``compile_counts()`` identical
+    per dtype (the dtype rides the compile key, it never adds
+    executables); zero post-warmup recompiles on both servers;
+    HBMLedger kv_pool+kv_scales <= 0.27x the fp32 kv_pool bytes; the
+    ``mingpt_serve_kv_dtype`` build-info gauge and a sampled
+    ``mingpt_serve_quant_logit_err_max``; and the fp8 gate (resolves on
+    a backend with float8_e4m3fn, refuses loudly otherwise)."""
+    import jax
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import InferenceServer, Request
+    from mingpt_distributed_tpu.serving import quant as quant_lib
+    from mingpt_distributed_tpu.telemetry import (
+        MetricsRegistry,
+        parse_prometheus,
+        render_prometheus,
+    )
+
+    cfg = GPTConfig.make(
+        n_layer=2, n_head=4, n_embd=256, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's",
+              "Once more unto the breach", "Once more unto the wall!"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 12
+
+    def run_once(kv_dtype):
+        reg = MetricsRegistry()
+        srv = InferenceServer(
+            params, cfg, n_slots=2, registry=reg, attrib=True,
+            prefill_buckets=(8, 48), prefill_chunk=6,
+            prefix_cache_mb=0.5, warmup=True,
+            draft_params=params, draft_cfg=cfg, spec_k=2,
+            kv_dtype=kv_dtype,
+        )
+        handles = srv.generate_batch(
+            [Request(prompt=p, max_new_tokens=max_new) for p in prompts])
+        return srv, reg, [h.tokens for h in handles]
+
+    rc = 0
+    srv32, _, toks32 = run_once("fp32")
+    srv8, reg8, toks8 = run_once("int8")
+
+    # tolerance-gated greedy parity: int8 KV storage may flip a late
+    # token on a near-tie, so the gate is a common-prefix ratio, not
+    # exact equality (the fp32 path keeps the exact-parity selftests)
+    agree = total = 0
+    for text, a, b in zip(canned, toks32, toks8):
+        lcp = 0
+        for x, y in zip(a, b):
+            if x != y:
+                break
+            lcp += 1
+        agree += lcp
+        total += len(a)
+        print(f"selftest-quant ({text!r}): "
+              + ("OK" if lcp == len(a) else
+                 f"prefix {lcp}/{len(a)} fp32={a} int8={b}"))
+    if total == 0 or agree / total < 0.9:
+        print(f"selftest-quant FAIL: parity {agree}/{total} below the "
+              f"0.9 tolerance gate")
+        rc = 1
+
+    c32, c8 = srv32.compile_counts(), srv8.compile_counts()
+    if c32 != c8:
+        print(f"selftest-quant FAIL: compile_counts diverge by dtype: "
+              f"fp32={c32} int8={c8}")
+        rc = 1
+    for name, srv in (("fp32", srv32), ("int8", srv8)):
+        if srv.watchdog.recompiles:
+            print(f"selftest-quant FAIL: {name} watchdog counted "
+                  f"{srv.watchdog.recompiles} post-warmup recompile(s)")
+            rc = 1
+    if srv8.metrics.prefix_hits < 1:
+        print("selftest-quant FAIL: no prefix hit on the int8 server")
+        rc = 1
+    if srv8.metrics.spec_rounds < 1:
+        print("selftest-quant FAIL: no speculative rounds on int8")
+        rc = 1
+
+    # the hard bytes gate: payload + scale planes vs the fp32 pool
+    pd32 = srv32.attrib_report()["hbm"]["per_device_bytes"]
+    pd8 = srv8.attrib_report()["hbm"]["per_device_bytes"]
+    kv8 = pd8.get("kv_pool", 0) + pd8.get("kv_scales", 0)
+    ratio = kv8 / pd32["kv_pool"]
+    if "kv_scales" not in pd8 or pd8["kv_scales"] <= 0:
+        print("selftest-quant FAIL: no kv_scales HBM owner on int8")
+        rc = 1
+    if "kv_scales" in pd32:
+        print("selftest-quant FAIL: fp32 report grew a kv_scales owner")
+        rc = 1
+    if ratio > 0.27:
+        print(f"selftest-quant FAIL: kv_pool+kv_scales ratio {ratio:.4f} "
+              f"> 0.27")
+        rc = 1
+
+    # quantization quality, sampled into the gauge + asserted sane
+    err = quant_lib.max_abs_logit_error(
+        params, cfg, prompts[0], quant_lib.resolve_kv_dtype("int8"))
+    srv8.observe_quant_logit_error(err)
+    if not (0.0 < err < 0.5):
+        print(f"selftest-quant FAIL: max |dlogit| {err} out of range")
+        rc = 1
+    page = parse_prometheus(render_prometheus(reg8))
+    dtype_val = gerr = None
+    for n, labels, v in page["samples"]:
+        if n == "mingpt_serve_kv_dtype" and labels.get("kv_dtype") == "int8":
+            dtype_val = v
+        if n == "mingpt_serve_quant_logit_err_max":
+            gerr = v
+    if dtype_val != 1.0:
+        print("selftest-quant FAIL: mingpt_serve_kv_dtype{kv_dtype=int8} "
+              "!= 1 in the scrape")
+        rc = 1
+    if gerr is None or abs(gerr - err) > 1e-12:
+        print(f"selftest-quant FAIL: quant err gauge {gerr} != sampled "
+              f"{err}")
+        rc = 1
+
+    # the fp8 gate: resolves only where the backend dtype exists
+    if quant_lib.fp8_dtype() is None:
+        try:
+            quant_lib.resolve_kv_dtype("fp8")
+            print("selftest-quant FAIL: fp8 resolved without a backend "
+                  "float8_e4m3fn")
+            rc = 1
+        except ValueError:
+            pass
+    else:
+        q = quant_lib.resolve_kv_dtype("fp8")
+        if q is None or q.name != "fp8":
+            print(f"selftest-quant FAIL: fp8 resolved to {q!r}")
+            rc = 1
+
+    print(f"selftest-quant bytes: int8 kv_pool+kv_scales={kv8} "
+          f"fp32 kv_pool={pd32['kv_pool']} ratio={ratio:.4f}")
+    print(f"selftest-quant err={err:.6f} counts={c8}")
+    print("selftest-quant", "PASSED" if rc == 0 else "FAILED")
     return rc
 
 
@@ -1521,8 +1695,8 @@ def selftest_sharded(args) -> int:
                 rc = 1
         # stored entries must carry the pool's head-sharding — a prefix
         # hit is a chip-local row copy, never a gather
-        for key, (ek, ev) in srv2.engine.prefix_store.entries():
-            for arr in (ek, ev):
+        for key, entry in srv2.engine.prefix_store.entries():
+            for arr in entry.values():
                 shard = arr.sharding.shard_shape(arr.shape)
                 if shard[3] * 2 != arr.shape[3]:
                     print(f"selftest-sharded FAIL: prefix entry "
@@ -2128,6 +2302,8 @@ def main(argv=None) -> int:
         return selftest_chaos(args)
     if args.selftest_spec:
         return selftest_spec(args)
+    if args.selftest_quant:
+        return selftest_quant(args)
     if args.selftest:
         return selftest(args)
 

@@ -1,0 +1,87 @@
+"""GL003/GL007 fixtures — the hazards the quantized KV path must avoid.
+
+serving/quant.py makes the quantization descriptor part of each program
+family's COMPILE identity (ISSUE 18): the frozen ``KVQuant`` rides into
+the jit wrapper as a ``functools.partial`` bound kwarg — static,
+exactly like ``cfg`` and the pool sharding — and the fp32-vs-quantized
+decision therefore happens once per wrapper, never inside a trace.
+Inside the traced body the only data-dependent quantization decision
+(the all-zero channel whose scale must stay exact zero) is a masked
+``jnp.where`` select, never a Python branch: branching on a traced
+``amax`` or on a per-call descriptor would specialise per value and
+break the one-executable-per-family guarantee. And the quant-error
+gauge sampling must read the injected clock, never the wall, or the
+virtual-clock chaos tests stop being deterministic.
+
+Positives: a jitted body that takes the descriptor as a call argument
+and branches on it; a traced branch on the amax value. Suppressed: one
+traced clip-retry loop, inline disable. Negatives: the partial-bound
+descriptor constant; the un-jitted host-side resolve; the masked
+zero-channel select; the injected-clock gauge sampler.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+KV_QUANT = object()  # stands in for the frozen KVQuant descriptor
+
+
+def _dequant_lane(lane, kv_quant):
+    if kv_quant is None:  # clean: un-jitted helper — host branch
+        return lane
+    return {k: v * 2.0 for k, v in lane.items()}
+
+
+def _decode_like(params, lane, kv_quant=None):
+    out = {k: v + params for k, v in _dequant_lane(lane, kv_quant).items()}
+    return out
+
+
+# clean: the dtype-in-compile-key idiom — the descriptor is a
+# partial-bound constant of the wrapper, so the wrapper IS the dtype
+# decision and each family keeps one executable per engine
+decode_quantized = jax.jit(
+    functools.partial(_decode_like, kv_quant=KV_QUANT))
+
+
+@jax.jit
+def decode_takes_quant_per_call(lane, kv_quant):
+    if kv_quant is None:  # expect: GL003
+        return lane
+    return {k: v * 2.0 for k, v in lane.items()}
+
+
+@jax.jit
+def quantize_branches_on_amax(x, qmax):
+    amax = jnp.max(jnp.abs(x))
+    if amax > 0:  # expect: GL003
+        return x / (amax / qmax)
+    return x
+
+
+@jax.jit
+def quantize_clips_retry_traced(x, tries):
+    while tries < 3:  # graftlint: disable=GL003
+        tries = tries + 1
+    return x
+
+
+@jax.jit
+def quantize_masks_zero_channels(x, qmax):
+    # clean: the zero-channel decision as a masked select — the shape
+    # quant._pow2_scale uses, branch-free under trace
+    amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(amax > 0, amax / qmax, 0.0)
+    return x * scale
+
+
+def sample_quant_gauge_bad(gauge, err):
+    gauge((time.time(), err))  # expect: GL007
+    return err
+
+
+def sample_quant_gauge_injected(gauge, clock, err):
+    gauge((clock(), err))  # clean: the scheduler's injected clock
+    return err

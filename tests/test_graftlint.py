@@ -311,6 +311,29 @@ def test_sharded_engine_modules_clean_under_recompile_and_clock_rules():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_quant_module_clean_under_recompile_and_clock_rules():
+    """ISSUE 18: serving/quant.py is consumed INSIDE the lifetime-jitted
+    decode/prefill/verify bodies — a traced branch there (GL003) would
+    specialise the families per quantization value and break the
+    one-executable-per-family guarantee the quant selftest pins
+    (compile_counts identical across kv_dtypes, zero recompiles). The
+    module is in GL007 scope (serving/) and must also stay wall-clock
+    clean — the quant-error gauge is sampled through the scheduler's
+    injected clock. Both hold outright: no suppressions, no baseline
+    entries. The hazard shapes (per-call descriptor branch, traced amax
+    branch) and the approved idioms (partial-bound KVQuant, masked
+    zero-channel select) are pinned by the gl003_gl007_quant.py
+    fixture."""
+    path = os.path.join(
+        REPO, "mingpt_distributed_tpu", "serving", "quant.py")
+    cfg = Engine(select=["GL003", "GL007"], root=REPO).config
+    rel = os.path.relpath(path, REPO)
+    assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL003", "GL007"], root=REPO).run([path])
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_trafficlab_package_clean_under_clock_rule():
     """ISSUE 12: the traffic lab's byte-replayable sweeps depend on
     arrival schedules being virtual-timestamp data and the runner never

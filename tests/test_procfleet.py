@@ -331,8 +331,8 @@ def test_migrated_prefix_entries_stay_head_sharded_tp2(cfg_params):
     dst = sup.replica_by_name(report["to"])
     entries = dst.backend.worker.server.engine.prefix_store.entries()
     assert entries
-    for key, (ek, ev) in entries:
-        for arr in (ek, ev):
+    for key, entry in entries:
+        for arr in entry.values():
             shard = arr.sharding.shard_shape(arr.shape)
             assert shard[3] * 2 == arr.shape[3], (
                 f"migrated entry (rows={len(key)}) not head-sharded: "
@@ -562,9 +562,9 @@ def test_migrated_draft_rows_stay_head_sharded_tp2(cfg_params):
     spec_dec = sup.replica_by_name(
         report["to"]).backend.worker.server.spec
     assert spec_dec.pending_draft
-    for key, (dk, dv) in spec_dec.pending_draft.items():
+    for key, entry in spec_dec.pending_draft.items():
         assert list(key) == prompt[:len(key)]
-        for arr in (dk, dv):
+        for arr in entry.values():
             shard = arr.sharding.shard_shape(arr.shape)
             assert shard[3] * 2 == arr.shape[3], (
                 f"parked draft rows not head-sharded: "

@@ -517,7 +517,7 @@ def test_prefix_store_lru_and_byte_bounds(cfg_params):
 
     def entry(rows):
         a = jnp.zeros((rows,), jnp.float32)
-        return (a, a)  # 8 bytes per row total
+        return {"k": a, "v": a}  # 8 bytes per row total
 
     store = PrefixKVStore(capacity_bytes=80)  # room for 10 rows
     assert store.insert((1, 2, 3), entry(3))          # 24 bytes

@@ -174,8 +174,8 @@ def test_tp2_vs_tp1_parity_chunked_prefix_and_speculative(
     # gathers the rows to one chip
     entries = tp2_server.engine.prefix_store.entries()
     assert entries
-    for _, (ek, ev) in entries:
-        for arr in (ek, ev):
+    for _, entry in entries:
+        for arr in entry.values():
             shard = arr.sharding.shard_shape(arr.shape)
             assert shard[3] * 2 == arr.shape[3]
 

@@ -57,7 +57,10 @@ def _build_serving(tp: int):
     """The canonical audit config: the selftest-sharded tiny GPT, a
     2-slot engine with a {8, 48} prefill ladder and the prefix store on
     (so the copy families register), plus a k=2 speculative decoder
-    whose draft is the same tiny model."""
+    whose draft is the same tiny model. Returns the fp32 stack AND its
+    int8 twin (ISSUE 18): same geometry, ``kv_dtype="int8"`` — its
+    families audit under the ``q8_`` prefix, proving dequant adds no
+    collectives and donation aliasing survives the dtype change."""
     import jax
 
     from mingpt_distributed_tpu.config import GPTConfig, MeshConfig
@@ -78,7 +81,12 @@ def _build_serving(tp: int):
         prefix_cache_mb=0.5, mesh=mesh,
     )
     spec = SpeculativeDecoder(engine, params, cfg, k=2)
-    return engine, spec
+    q8_engine = DecodeEngine(
+        params, cfg, n_slots=2, prefill_buckets=(8, 48),
+        prefix_cache_mb=0.5, mesh=mesh, kv_dtype="int8",
+    )
+    q8_spec = SpeculativeDecoder(q8_engine, params, cfg, k=2)
+    return engine, spec, q8_engine, q8_spec
 
 
 def _build_trainer(tmpdir: str):
@@ -190,10 +198,16 @@ def main(argv=None) -> int:
     clock = lambda: 0.0  # noqa: E731 — no timing may enter the report
     with contextlib.redirect_stdout(sys.stderr), \
             tempfile.TemporaryDirectory() as tmpdir:
-        engine, spec = _build_serving(args.tp)
+        engine, spec, q8_engine, q8_spec = _build_serving(args.tp)
         engine.register_attrib(ledger, clock)
         spec.register_attrib(ledger, clock)
-        contracts = {**engine.audit_contracts(), **spec.audit_contracts()}
+        q8_engine.register_attrib(ledger, clock, family_prefix="q8_")
+        q8_spec.register_attrib(ledger, clock, family_prefix="q8_")
+        contracts = {
+            **engine.audit_contracts(), **spec.audit_contracts(),
+            **q8_engine.audit_contracts(family_prefix="q8_"),
+            **q8_spec.audit_contracts(family_prefix="q8_"),
+        }
         if args.tp == 1:
             trainer = _build_trainer(tmpdir)
             trainer.register_attrib(ledger, clock)

@@ -23,7 +23,10 @@ Input kinds (both files must be the same kind):
   ``sharded_serving`` block (ISSUE 14: per-device KV-pool bytes and
   decode/prefill ms at tp=1 vs tp=2), its numeric leaves are diffed
   too — bytes are exact layout facts, ``*_ms`` leaves get the timing
-  noise thresholds.
+  noise thresholds. Likewise the serving probe's ``quantized`` block
+  (ISSUE 18): bytes-per-slot exact lower-is-better, ``max_slots_*``
+  exact HIGHER-is-better (the slots-per-chip multiplier), decode
+  ``*_ms`` noise-aware.
 * ``graftaudit-budgets/1`` documents (``program_budgets.json``, ISSUE
   15): exact-match semantics on every ``sweep.program.metric`` leaf —
   budgets are compiled-program properties, so no tolerance applies and
@@ -223,6 +226,47 @@ def _sharded_serving_rows(
     return rows
 
 
+def _quantized_rows(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    rel_tol: float,
+) -> List[Dict[str, Any]]:
+    """Diff rows for the serving probe's ``quantized`` block when both
+    reports carry one (ISSUE 18); [] otherwise, so old BENCH files diff
+    exactly as before. Bytes-per-slot and the bytes ratio are layout
+    facts — exact, lower-is-better (shrinking the pool is the point of
+    quantizing); ``max_slots_*`` is the slots-per-chip multiplier the
+    feature exists to raise, so it is exact and HIGHER-is-better; the
+    ``*_ms`` decode timings get the relative tolerance plus the same
+    0.05 ms floor the sharded_serving rows use."""
+    qa = (a.get("serving") or {}).get("quantized")
+    qb = (b.get("serving") or {}).get("quantized")
+    if not (isinstance(qa, dict) and isinstance(qb, dict)):
+        return []
+    rows = []
+    for name in sorted(set(qa) | set(qb)):
+        va, vb = qa.get(name), qb.get(name)
+        if not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                   for v in (va, vb) if v is not None):
+            continue  # kv_dtype and any other string leaves
+        timing = name.endswith("_ms")
+        higher = "slots" in name
+        cell = _verdict(
+            None if va is None else float(va),
+            None if vb is None else float(vb),
+            rel_tol if timing else 1e-9,
+            0.05 if timing else 0.0,
+            lower_better=not higher,
+        )
+        rows.append({
+            "metric": f"quantized.{name}",
+            "unit": "ms" if timing else None,
+            "direction": "higher_better" if higher else "lower_better",
+            **cell,
+        })
+    return rows
+
+
 def diff_budget_reports(
     a: Dict[str, Any],
     b: Dict[str, Any],
@@ -299,6 +343,7 @@ def diff_bench_reports(
         **cell,
     }]
     rows.extend(_sharded_serving_rows(a, b, rel_tol))
+    rows.extend(_quantized_rows(a, b, rel_tol))
     return {
         "schema": "mingpt-bench/1-diff",
         "rel_tol": rel_tol,
