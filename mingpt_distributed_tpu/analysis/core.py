@@ -118,6 +118,11 @@ class Config:
         # (socket timeouts are connection attributes, not time.* calls,
         # and stay allowed)
         "serving/procfleet/",
+        # likewise pinned outright: heartbeat deadlines, the token-bucket
+        # pacing budget, and transfer retry backoff all live on the
+        # injected clock — the module imports no `time` at all, which
+        # the hostplane pin test asserts
+        "serving/procfleet/hostplane.py",
         "training/faults.py",
         "telemetry/tracing.py",
         "telemetry/flightrec.py",

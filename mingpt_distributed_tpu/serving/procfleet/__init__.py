@@ -16,14 +16,34 @@ Layering (each module imports only downward):
 * :mod:`.supervisor` — :class:`ProcReplica` / :class:`ProcessSupervisor`
   / :class:`ProcRouter`: the in-process fleet machinery re-based onto
   the boundary, plus ``migrate_and_drain`` live migration.
+* :mod:`.hostplane` — the cross-host control plane (ISSUE 19):
+  :class:`HostAgent` membership/auth/quorum per host,
+  :class:`CrossHostRouter` epoch-fenced failover and paced cross-host
+  migration, :class:`PacedChannel` bandwidth budgeting, and the
+  loopback multi-host mesh builder for deterministic partition drills.
 """
 
+from mingpt_distributed_tpu.serving.procfleet.hostplane import (
+    CrossHandle,
+    CrossHostRouter,
+    HostAgent,
+    PacedChannel,
+    PacedTransferError,
+    build_loopback_fleet,
+)
 from mingpt_distributed_tpu.serving.procfleet.rpc import (
+    AuthError,
+    BadSignature,
     EnvelopeError,
     FRAME_MAGIC,
+    FleetAuth,
     RPC_SCHEMA,
+    ReplayedNonce,
     TransportError,
     TransportTimeout,
+    TransportUnavailable,
+    UnsignedEnvelope,
+    canonical_bytes,
     envelope,
     pack_frames,
     request_from_wire,
@@ -43,6 +63,7 @@ from mingpt_distributed_tpu.serving.procfleet.supervisor import (
     process_backend_factory,
 )
 from mingpt_distributed_tpu.serving.procfleet.transport import (
+    LoopbackHostLink,
     LoopbackTransport,
     SocketTransport,
 )
@@ -52,15 +73,25 @@ from mingpt_distributed_tpu.serving.procfleet.worker import (
 )
 
 __all__ = [
+    "AuthError",
+    "BadSignature",
+    "CrossHandle",
+    "CrossHostRouter",
     "EnvelopeError",
     "FRAME_MAGIC",
+    "FleetAuth",
+    "HostAgent",
     "LoopbackBackend",
+    "LoopbackHostLink",
     "LoopbackTransport",
+    "PacedChannel",
+    "PacedTransferError",
     "ProcReplica",
     "ProcRouter",
     "ProcessBackend",
     "ProcessSupervisor",
     "RPC_SCHEMA",
+    "ReplayedNonce",
     "ReplicaUnreachable",
     "ReplicaWorker",
     "RpcHttpServer",
@@ -68,6 +99,10 @@ __all__ = [
     "SocketTransport",
     "TransportError",
     "TransportTimeout",
+    "TransportUnavailable",
+    "UnsignedEnvelope",
+    "build_loopback_fleet",
+    "canonical_bytes",
     "envelope",
     "loopback_backend_factory",
     "pack_frames",

@@ -24,6 +24,8 @@ byte-identical state.
 
 from __future__ import annotations
 
+import hashlib
+import hmac
 import json
 import struct
 from typing import Any, Dict, List, Optional, Tuple
@@ -39,6 +41,13 @@ __all__ = [
     "EnvelopeError",
     "TransportError",
     "TransportTimeout",
+    "TransportUnavailable",
+    "AuthError",
+    "UnsignedEnvelope",
+    "BadSignature",
+    "ReplayedNonce",
+    "FleetAuth",
+    "canonical_bytes",
     "envelope",
     "validate_envelope",
     "pack_frames",
@@ -62,6 +71,40 @@ class TransportTimeout(TransportError):
     """The RPC timed out (socket timeout / injected hang). The replica
     is presumed alive; the round is lost, the breaker records a
     failure."""
+
+
+class TransportUnavailable(TransportError):
+    """The peer could not be reached at all (connection refused/reset
+    through the bounded retry budget). Distinct from
+    :class:`TransportTimeout`: nothing was in flight, so the call is
+    safe to re-route rather than treat as a lost round."""
+
+
+class AuthError(EnvelopeError):
+    """An envelope failed fleet authentication. Subclasses carry a
+    ``reason`` label matching ``mingpt_fleet_auth_rejects_total``."""
+
+    reason = "auth"
+
+
+class UnsignedEnvelope(AuthError):
+    """Auth is required but the envelope carries no ``auth`` field."""
+
+    reason = "unsigned"
+
+
+class BadSignature(AuthError):
+    """The HMAC over the canonical bytes does not verify — tampering or
+    a wrong fleet secret."""
+
+    reason = "bad_mac"
+
+
+class ReplayedNonce(AuthError):
+    """A verified envelope arrived with a non-monotonic nonce — a
+    replayed (or badly reordered) frame."""
+
+    reason = "replay"
 
 
 # ---------------------------------------------------------------------
@@ -92,6 +135,12 @@ _KIND_FIELDS: Dict[str, Dict[str, Any]] = {
     "stream_token": {"request_id": str, "token": int, "token_index": int},
     "stream_end": {"request_id": str, "finish_reason": str},
     "error": {"error": str, "message": str},
+    # host <-> host (ISSUE 19 hostplane)
+    "heartbeat": {"host": str, "epoch": int, "seq": int},
+    "heartbeat_ack": {"host": str, "epoch": int, "seq": int},
+    "xfer_chunk": {"xfer_id": str, "seq": int, "n_chunks": int,
+                   "digest": str, "total_bytes": int},
+    "xfer_ack": {"xfer_id": str, "seq": int, "ok": bool},
 }
 
 #: event types allowed inside step_result.events
@@ -241,3 +290,99 @@ def unpack_frames(blob: bytes) -> List[Tuple[Dict[str, Any], bytes]]:
         raise EnvelopeError(
             f"transfer channel: {len(blob) - pos} trailing bytes")
     return frames
+
+
+# ---------------------------------------------------------------------
+# Fleet authentication (ISSUE 19)
+# ---------------------------------------------------------------------
+
+
+def canonical_bytes(doc: Dict[str, Any]) -> bytes:
+    """The byte form an envelope is signed over: sorted-key JSON of the
+    document WITHOUT its ``auth`` field. Deterministic by construction —
+    the same discipline as the transfer-channel frame meta."""
+    body = {k: v for k, v in doc.items() if k != "auth"}
+    return json.dumps(body, sort_keys=True).encode()
+
+
+class FleetAuth:
+    """HMAC-SHA256 envelope signer/verifier with monotonic per-sender
+    nonces — the shared-secret trust boundary of the cross-host mesh.
+
+    ``sign`` stamps ``doc["auth"] = {"sender", "nonce", "mac"}`` where
+    the MAC covers ``canonical_bytes(doc) + sender + nonce``; extra
+    fields are open in the envelope grammar, so signed and unsigned
+    envelopes validate identically and auth-off stays byte-identical.
+
+    ``verify`` raises typed :class:`AuthError` subclasses and bumps
+    ``mingpt_fleet_auth_rejects_total{reason}`` when given a registry:
+    missing auth → :class:`UnsignedEnvelope`; MAC mismatch →
+    :class:`BadSignature`; a nonce at-or-below the last one seen from
+    that sender → :class:`ReplayedNonce`. Nonces are per-sender counters
+    (monotonic, not random), so replay detection needs no clock and two
+    identical runs verify identically."""
+
+    def __init__(self, secret: str, sender: str, registry=None):
+        if not secret:
+            raise ValueError("fleet secret must be non-empty")
+        self._key = secret.encode()
+        self.sender = sender
+        self._next_nonce = 0
+        self._last_seen: Dict[str, int] = {}
+        self._rejects = None
+        if registry is not None:
+            self._rejects = registry.counter(
+                "mingpt_fleet_auth_rejects_total",
+                help="envelopes/frames rejected by fleet auth, by reason",
+                labels=("reason",))
+
+    def _mac(self, payload: bytes, sender: str, nonce: int) -> str:
+        msg = payload + b"|" + sender.encode() + b"|" + str(nonce).encode()
+        return hmac.new(self._key, msg, hashlib.sha256).hexdigest()
+
+    def _reject(self, exc_cls, msg: str):
+        if self._rejects is not None:
+            self._rejects.labels(reason=exc_cls.reason).inc()
+        raise exc_cls(msg)
+
+    def sign(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Return ``doc`` with a fresh ``auth`` stamp (mutates in
+        place; signing is the last step before serialization)."""
+        nonce = self._next_nonce
+        self._next_nonce += 1
+        doc["auth"] = {"sender": self.sender, "nonce": nonce,
+                       "mac": self._mac(canonical_bytes(doc),
+                                        self.sender, nonce)}
+        return doc
+
+    def verify(self, doc: Dict[str, Any]) -> Dict[str, Any]:
+        """Verify and return ``doc``; typed raise + counter on reject."""
+        auth = doc.get("auth")
+        if auth is None:
+            self._reject(UnsignedEnvelope,
+                         f"unsigned envelope kind={doc.get('kind')!r}")
+        if (not isinstance(auth, dict)
+                or not isinstance(auth.get("sender"), str)
+                or not isinstance(auth.get("nonce"), int)
+                or isinstance(auth.get("nonce"), bool)
+                or not isinstance(auth.get("mac"), str)):
+            self._reject(BadSignature, "malformed auth stamp")
+        sender, nonce = auth["sender"], auth["nonce"]
+        want = self._mac(canonical_bytes(doc), sender, nonce)
+        if not hmac.compare_digest(want, auth["mac"]):
+            self._reject(BadSignature,
+                         f"bad MAC on {doc.get('kind')!r} from {sender}")
+        last = self._last_seen.get(sender)
+        if last is not None and nonce <= last:
+            self._reject(ReplayedNonce,
+                         f"replayed nonce {nonce} (last {last}) from "
+                         f"{sender}")
+        self._last_seen[sender] = nonce
+        return doc
+
+    def reject_frame_digest(self, msg: str) -> None:
+        """Count + raise a transfer-chunk digest mismatch under the same
+        rejects family (reason ``frame_digest``)."""
+        if self._rejects is not None:
+            self._rejects.labels(reason="frame_digest").inc()
+        raise BadSignature(msg)

@@ -913,6 +913,60 @@ class ProcRouter(Router):
         return merge_fleet_pages(
             render_prometheus(self.supervisor.registry), pages)
 
+    def export_migrate_blob(self, src) -> bytes:
+        """Fetch ``src``'s size-framed migration blob (KV/prefix/draft
+        state) over RPC. Building block shared with the cross-host
+        :class:`~.hostplane.CrossHostRouter`, which pushes the same blob
+        through a :class:`~.hostplane.PacedChannel` instead of a direct
+        POST."""
+        return src.backend.transport.fetch_bytes("/rpc/migrate_out")
+
+    def install_migrate_blob(self, dst, blob: bytes) -> Dict[str, Any]:
+        """Install a migration blob into ``dst``; returns the validated
+        ``migrate_in_result`` envelope (raises EnvelopeError on a
+        mismatched answer)."""
+        resp = dst.backend.transport.post_bytes("/rpc/migrate_in", blob)
+        if resp.get("kind") != "migrate_in_result":
+            raise EnvelopeError(
+                f"migrate_in answered with {resp.get('kind')!r}: "
+                f"{resp.get('message')}")
+        return resp
+
+    def detach_unfinished(self, src_name: str,
+                          to_label: str = "") -> List[Any]:
+        """Pop every in-flight attempt off ``src_name``: finished ones
+        resolve normally, unfinished ones get their attempt span closed
+        as ``migrated`` (plus a trace event) and are returned for the
+        caller to re-queue — locally into ``_pending`` or on another
+        host entirely. The handles are NOT re-queued here."""
+        now = self.clock.now()
+        unfinished: List[Any] = []
+        for key in [k for k in self._attempts if k[0] == src_name]:
+            fh, rh = self._attempts.pop(key)
+            if rh.finished:
+                self._resolve_finished(src_name, fh, rh, crashed=False)
+                continue
+            self._close_attempt_span(fh, rh, "migrated")
+            if self.trace_recorder is not None and fh.trace is not None:
+                self.trace_recorder.add_event(
+                    fh.trace, "migrate", now,
+                    from_replica=src_name, to_replica=to_label)
+                self.trace_recorder.mark_forced(fh.trace)
+            unfinished.append(fh)
+        return unfinished
+
+    def drain_and_retire(self, src) -> Dict[str, Any]:
+        """Best-effort drain envelope, then terminal retirement (exit
+        75 per the requeue contract). Returns the shutdown info."""
+        try:
+            src.backend.transport.call(
+                "/rpc/drain", envelope("drain", migrate=True))
+        except TransportError:
+            pass  # already unreachable; retirement reaps it either way
+        info = self.supervisor.retire_replica(src)
+        self._update_gauges()
+        return info
+
     def migrate_and_drain(self, src_name: str,
                           dst_name: Optional[str] = None) -> Dict[str, Any]:
         """Drain ``src_name`` with zero loss: ship its prefix/KV state to
@@ -944,13 +998,8 @@ class ProcRouter(Router):
         outcome, installed, skipped, error = "ok", 0, 0, None
         draft_installed = 0
         try:
-            blob = src.backend.transport.fetch_bytes("/rpc/migrate_out")
-            resp = dst.backend.transport.post_bytes("/rpc/migrate_in",
-                                                    blob)
-            if resp.get("kind") != "migrate_in_result":
-                raise EnvelopeError(
-                    f"migrate_in answered with {resp.get('kind')!r}: "
-                    f"{resp.get('message')}")
+            blob = self.export_migrate_blob(src)
+            resp = self.install_migrate_blob(dst, blob)
             installed = resp["installed"]
             skipped = resp["skipped"]
             draft_installed = resp.get("draft_installed", 0)
@@ -961,26 +1010,10 @@ class ProcRouter(Router):
         # dedup emitter suppresses indices the caller already saw, so
         # the visible stream stays append-only and token-exact
         moved: List[str] = []
-        for key in [k for k in self._attempts if k[0] == src_name]:
-            fh, rh = self._attempts.pop(key)
-            if rh.finished:
-                self._resolve_finished(src_name, fh, rh, crashed=False)
-                continue
-            self._close_attempt_span(fh, rh, "migrated")
-            if self.trace_recorder is not None and fh.trace is not None:
-                self.trace_recorder.add_event(
-                    fh.trace, "migrate", now,
-                    from_replica=src_name, to_replica=dst.name)
-                self.trace_recorder.mark_forced(fh.trace)
+        for fh in self.detach_unfinished(src_name, to_label=dst.name):
             self._pending.append((fh, now))
             moved.append(fh.request_id)
-        try:
-            src.backend.transport.call(
-                "/rpc/drain", envelope("drain", migrate=True))
-        except TransportError:
-            pass  # already unreachable; retirement reaps it either way
-        info = self.supervisor.retire_replica(src)
-        self._update_gauges()
+        info = self.drain_and_retire(src)
         report = {
             "schema": "mingpt-migrate/1",
             "from": src_name,

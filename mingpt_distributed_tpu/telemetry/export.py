@@ -327,6 +327,7 @@ def parse_prometheus(text: str) -> Dict[str, Any]:
 def merge_fleet_pages(
     base_page: Optional[str],
     replica_pages: Dict[str, str],
+    label: str = "replica",
 ) -> str:
     """Fleet merge over ALREADY-RENDERED exposition pages (ISSUE 16).
 
@@ -341,7 +342,11 @@ def merge_fleet_pages(
     sorted (families, then base-before-replicas in sorted replica order)
     so identical inputs render byte-identically. Every input page is
     strict-parsed first — a replica shipping a malformed page fails the
-    merge loudly instead of corrupting the fleet scrape."""
+    merge loudly instead of corrupting the fleet scrape.
+
+    ``label`` renames the injected label: the cross-host fleet (ISSUE
+    19) merges per-host pages — which already carry ``replica`` labels —
+    under ``label="host"``, so a two-level scrape stays coherent."""
     sources: List[Tuple[Optional[str], str]] = []
     if base_page is not None:
         sources.append((None, base_page))
@@ -386,7 +391,7 @@ def merge_fleet_pages(
         out.append(f"# TYPE {fam} {kinds[fam]}")
         for replica, name, labels, value in fam_samples[fam]:
             if replica is not None:
-                labels = {"replica": replica, **labels}
+                labels = {label: replica, **labels}
             out.append(_sample(name, labels, value))
     return "\n".join(out) + "\n"
 

@@ -176,7 +176,10 @@ def render_traffic_report(report: Dict[str, Any]) -> str:
         f"  slo: {report['slo_spec']}  (knee objective: "
         f"{report['knee_objective']})"
         + (f"  chaos: {report['chaos_spec']}" if report.get("chaos_spec")
-           else ""),
+           else "")
+        + (f"  net-chaos: {report['net_chaos_spec']} "
+           f"({report['fleet'].get('n_hosts', 1)} hosts)"
+           if report.get("net_chaos_spec") else ""),
         f"  {'rung':>4} {'offered':>9} {'policy':<6} {'grade':>5} "
         f"{'attain':>7} {'done':>5} {'shed':>5} {'expired':>7} "
         f"{'dl-hit':>7}",

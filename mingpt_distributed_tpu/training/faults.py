@@ -74,14 +74,17 @@ ENV_VAR = "MINGPT_FAULTS"
 ENV_TARGET = "MINGPT_FAULT_TARGET"
 SERVING_ENV_VAR = "MINGPT_SERVING_FAULTS"
 PROCESS_ENV_VAR = "MINGPT_PROCESS_FAULTS"
+NET_ENV_VAR = "MINGPT_NET_FAULTS"
 
 #: Filesystem fault points (the original grammar) vs serving fault points
-#: (fleet chaos harness) vs process fault points (procfleet RPC boundary).
-#: One FaultSpec grammar covers all three; which set an injector accepts
-#: is validated at construction.
+#: (fleet chaos harness) vs process fault points (procfleet RPC boundary)
+#: vs network fault points (hostplane mesh, ISSUE 19). One FaultSpec
+#: grammar covers all four; which set an injector accepts is validated
+#: at construction.
 IO_OPS = ("write", "read")
 SERVING_OPS = ("crash", "poison", "slow", "admit")
 PROCESS_OPS = ("kill", "hang", "slow_socket", "stuck_step")
+NET_OPS = ("partition", "drop_frame", "slow_link", "host_kill")
 
 
 @dataclass
@@ -99,11 +102,12 @@ class FaultSpec:
     count: int = field(default=0, compare=False)
 
     def __post_init__(self):
-        known = IO_OPS + SERVING_OPS + PROCESS_OPS
+        known = IO_OPS + SERVING_OPS + PROCESS_OPS + NET_OPS
         if self.op not in known:
             raise ValueError(
                 f"fault op must be one of {known}, got {self.op!r}")
-        if self.op in ("slow", "slow_socket") and self.mode == "error":
+        if self.op in ("slow", "slow_socket", "slow_link") \
+                and self.mode == "error":
             # slowness only makes sense as a delay; default the mode so
             # specs read naturally ("slow:every=1:delay=0.25")
             self.mode = "delay"
@@ -497,6 +501,108 @@ class ProcessFaultInjector:
             self.sleep(spec.delay_s)
             return 0.0
         return spec.delay_s
+
+
+class LinkPartitioned(InjectedServingFault):
+    """The host-to-host link is partitioned: the frame never arrives and
+    the caller sees a transport failure, exactly like a cable pull. The
+    hostplane's heartbeat ladder — not this exception — decides when the
+    peer is *suspect* vs *dead*."""
+
+
+class NetworkFaultInjector:
+    """Deterministic fault schedule over the hostplane mesh (ISSUE 19),
+    sharing :class:`FaultSpec`'s grammar and counters with the other
+    injectors. ``match`` filters on the **link key** ``"src->dst"`` (so
+    ``match=host0`` partitions every link touching host0, and
+    ``match=host0->host1`` exactly one direction). Env knob:
+    ``MINGPT_NET_FAULTS``. Fault points:
+
+    * ``link_verdict(src, dst)`` — before any frame crosses the link.
+      A due ``partition`` opens the partition (for ``delay`` virtual
+      seconds on the injected clock, or until :meth:`heal` when no delay
+      is given) and every call while it is open raises
+      :class:`LinkPartitioned`; a due ``slow_link`` returns the extra
+      seconds the frame takes (the PacedChannel charges them against its
+      bandwidth clock — nothing here ever sleeps).
+    * ``frame_verdict(src, dst)`` — per transfer-channel chunk; a due
+      ``drop_frame`` returns True and the chunk is lost (the resumable
+      transfer retries from the last acked chunk).
+    * ``host_verdict(host)`` — a due ``host_kill`` returns True and the
+      whole host dies (every replica SIGKILLed, agent stops answering
+      heartbeats).
+    """
+
+    def __init__(self, faults: Optional[str] = None, clock=None):
+        text = faults if faults is not None else os.environ.get(
+            NET_ENV_VAR, "")
+        self.specs = parse_faults(text)
+        for s in self.specs:
+            if s.op not in NET_OPS:
+                raise ValueError(
+                    f"network fault op must be one of {NET_OPS}, "
+                    f"got {s.op!r} (process ops belong in "
+                    f"{PROCESS_ENV_VAR})")
+        self.clock = clock
+        self.fired: List[str] = []            # "op:link" audit trail
+        #: link key -> virtual deadline (None = open until heal())
+        self._partitions: dict = {}
+
+    def _fire(self, op: str, key: str) -> Optional[FaultSpec]:
+        for s in self.specs:
+            if s.fires(op, key):
+                self.fired.append(f"{op}:{key}")
+                return s
+        return None
+
+    def reset_counters(self) -> None:
+        for s in self.specs:
+            s.count = 0
+        self.fired = []
+        self._partitions = {}
+
+    def heal(self) -> None:
+        """Close every open partition (the cable is plugged back in).
+        Spec counters keep advancing — a periodic partition can re-open
+        later; only :meth:`reset_counters` rewinds the schedule."""
+        self._partitions = {}
+
+    def _partition_open(self, key: str) -> bool:
+        if key not in self._partitions:
+            return False
+        until = self._partitions[key]
+        if until is None:
+            return True
+        now = self.clock.now() if self.clock is not None else 0.0
+        if now >= until:
+            del self._partitions[key]
+            return False
+        return True
+
+    # -- fault points ---------------------------------------------------
+    def link_verdict(self, src: str, dst: str) -> float:
+        """Partition/slow verdict for one frame over ``src->dst``.
+        Raises :class:`LinkPartitioned` or returns extra seconds of
+        injected link slowness (0.0 when healthy)."""
+        key = f"{src}->{dst}"
+        spec = self._fire("partition", key)
+        if spec is not None:
+            until = None
+            if spec.delay_s > 0 and self.clock is not None:
+                until = self.clock.now() + spec.delay_s
+            self._partitions[key] = until
+        if self._partition_open(key):
+            raise LinkPartitioned(f"injected partition: link {key} is down")
+        spec = self._fire("slow_link", key)
+        return spec.delay_s if spec is not None else 0.0
+
+    def frame_verdict(self, src: str, dst: str) -> bool:
+        """True when this transfer-channel chunk should be dropped."""
+        return self._fire("drop_frame", f"{src}->{dst}") is not None
+
+    def host_verdict(self, host: str) -> bool:
+        """True when ``host`` should die wholesale on this check."""
+        return self._fire("host_kill", host) is not None
 
 
 def register() -> None:

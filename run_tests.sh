@@ -201,6 +201,26 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python serve.py --selftest-standby --spill-dir "$OBS_DIR/standby-spill"
 
+# Cross-host fleet gate (ISSUE 19): two real localhost host agents,
+# each supervising its own fleet of replica subprocesses, exchanging
+# HMAC-signed control envelopes on the wall clock. SIGKILL an entire
+# host mid-decode: the peer's heartbeat ladder must quarantine it, the
+# frontend must declare it failed and adopt its requests behind the
+# epoch fence — every stream token-exact with zero duplicates or
+# losses, recovery rows labelled path=crosshost. Then live-migrate a
+# mid-decode replica cross-host through the token-bucket PacedChannel
+# under an injected slow_link: the measured wall transfer time must
+# respect the bandwidth budget (bytes/rate + per-chunk latency) and
+# the migrated streams stay exact. Finally a control frame tampered
+# after signing must be rejected with the typed BadSignature error and
+# a distinct auth-reject counter. Exits non-zero on any violation.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python serve.py --selftest-crosshost --hosts 2 \
+        --fleet-secret ci-drill-secret \
+        --spill-dir "$OBS_DIR/crosshost-spill"
+
 # The exported artifacts must round-trip through the offline tool too:
 # trace_summary renders per-request timelines + the SLO grade from the
 # same files the gate just validated in-process, and --compare diffs

@@ -279,6 +279,28 @@ def build_argparser() -> argparse.ArgumentParser:
                         "escalation ladder clears it; migrate a "
                         "speculative request and verify the peer resumes "
                         "proposing from shipped draft rows; then exits")
+    p.add_argument("--hosts", type=int, default=1, metavar="N",
+                   help="cross-host roster size for the hostplane drills "
+                        "(ISSUE 19): each host runs a HostAgent owning "
+                        "its own process-isolated replica fleet")
+    p.add_argument("--fleet-secret", default=None, metavar="SECRET",
+                   help="shared fleet secret: HMAC-sign every cross-host "
+                        "control envelope over its canonical bytes; "
+                        "unsigned/tampered/replayed frames are rejected "
+                        "with typed errors and counted on "
+                        "mingpt_fleet_auth_rejects_total. Default off — "
+                        "single-host paths stay byte-identical")
+    p.add_argument("--selftest-crosshost", action="store_true",
+                   help="ISSUE 19 gate: two real localhost host agents, "
+                        "each supervising real replica subprocesses — "
+                        "SIGKILL a whole host mid-decode and verify the "
+                        "peer adopts its requests with zero duplicate or "
+                        "lost stream tokens; live-migrate cross-host "
+                        "through the paced channel under a slow_link "
+                        "spec and verify the wall-clock transfer "
+                        "respects the bandwidth budget; post a tampered "
+                        "control frame and verify the typed reject plus "
+                        "auth counter; then exits")
     p.add_argument("--selftest-attrib", action="store_true",
                    help="ISSUE 13 gate: per-program attribution ledger "
                         "(prefill/decode/verify/draft/train families with "
@@ -2288,12 +2310,286 @@ def selftest_standby(args) -> int:
     return rc
 
 
+def selftest_crosshost(args) -> int:
+    """The ISSUE 19 acceptance gate, against REAL subprocesses.
+
+    Two (or ``--hosts``) localhost HostAgents on the wall clock, each
+    owning a ProcessSupervisor of real replica worker subprocesses
+    behind the mingpt-rpc/1 socket surface, exchanging HMAC-signed
+    control envelopes. Quorum is 1 for a two-host drill — a majority of
+    two is two, which no single-failure drill can survive.
+
+    Leg A — host death: SIGKILL every worker on host0 while one of its
+    requests is mid-decode and stop its agent (the machine died). The
+    peer's heartbeat ladder must quarantine it, the frontend must
+    declare it failed and adopt its requests, and every caller stream
+    must stay token-exact with zero duplicate or lost emissions
+    (``recovery_log`` path ``crosshost`` on the adopting host).
+
+    Leg B — paced migration under ``slow_link``: live-migrate a
+    mid-decode replica host0 -> host1 through the PacedChannel with
+    real sleeps; the measured wall transfer time must be at least the
+    token-bucket budget (bytes/rate plus injected per-chunk latency)
+    and the migrated streams must stay token-exact.
+
+    Leg C — a control frame tampered after signing is rejected with the
+    typed ``BadSignature`` error and a distinct
+    ``mingpt_fleet_auth_rejects_total{reason="bad_mac"}`` count."""
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mingpt_distributed_tpu.config import GPTConfig
+    from mingpt_distributed_tpu.models import generate as gen
+    from mingpt_distributed_tpu.models import gpt
+    from mingpt_distributed_tpu.serving import (
+        ProcRouter,
+        ProcessSupervisor,
+        Request,
+        WallClock,
+        process_backend_factory,
+    )
+    from mingpt_distributed_tpu.serving.procfleet import (
+        CrossHostRouter,
+        HostAgent,
+        LoopbackHostLink,
+        PacedChannel,
+        envelope,
+    )
+    from mingpt_distributed_tpu.telemetry import (
+        MetricsRegistry,
+        parse_prometheus,
+    )
+    from mingpt_distributed_tpu.training.faults import NetworkFaultInjector
+
+    cfg_kw = dict(
+        n_layer=2, n_head=2, n_embd=32, vocab_size=96, block_size=48,
+        embd_pdrop=0.0, resid_pdrop=0.0, attn_pdrop=0.0, dtype="float32",
+    )
+    cfg = GPTConfig.make(**cfg_kw)
+    params = gpt.init(jax.random.key(0), cfg)
+    canned = ["O God, O God!", "Once more unto", "All the world's",
+              "Now is the winter", "Friends, Romans", "To be, or not"]
+    prompts = [[ord(c) % cfg.vocab_size for c in s] for s in canned]
+    max_new = 12
+    secret = args.fleet_secret or "crosshost-drill-secret"
+    n_hosts = max(2, args.hosts)
+    spill_root = args.spill_dir or tempfile.mkdtemp(prefix="crosshost-")
+    rc = 0
+
+    def solo(p, n):
+        out = gen.generate(params, cfg, jnp.asarray(p, jnp.int32)[None], n)
+        return np.asarray(out)[0, len(p):].tolist()
+
+    def build_mesh(tag, net_faults="", paced_bytes_per_s=None):
+        clock = WallClock()
+        net = NetworkFaultInjector(net_faults)
+        roster = [f"host{i}" for i in range(n_hosts)]
+        spec = {"cfg": cfg_kw, "init_seed": 0,
+                "server": {"n_slots": 2, "prefill_chunk": 8,
+                           "prefix_cache_mb": 4.0}}
+        agents = {}
+        for host in roster:
+            sup = ProcessSupervisor(
+                process_backend_factory(
+                    spec, os.path.join(spill_root, f"{tag}-{host}"),
+                    rpc_timeout_s=120.0),
+                n_replicas=2, clock=clock, max_restarts=1,
+                restart_backoff_s=0.05, registry=MetricsRegistry())
+            router = ProcRouter(sup, max_retries=3, retry_backoff_s=0.01,
+                                breaker_reset_s=0.05)
+            agents[host] = HostAgent(host, router, roster, clock,
+                                     secret=secret,
+                                     heartbeat_interval_s=0.05, quorum=1)
+        for src in roster:
+            agents[src].connect({
+                dst: LoopbackHostLink(src, dst, agents[dst], net=net)
+                for dst in roster if dst != src})
+        streamed = {}
+        frontend = CrossHostRouter(
+            agents, clock, net=net,
+            on_token=lambda c, t: streamed.setdefault(
+                c.request_id, []).append(t))
+        # real waits: the drill paces against the wall clock
+        frontend.paced = PacedChannel(clock,
+                                      bytes_per_s=paced_bytes_per_s,
+                                      registry=frontend.registry,
+                                      sleep=time.sleep)
+        return frontend, agents, streamed
+
+    def check_parity(tag, handles, streamed):
+        ok = True
+        for p, h in zip(prompts, handles):
+            want = solo(p, max_new)
+            if h.finish_reason != "length" or h.tokens != want:
+                print(f"selftest-crosshost FAIL ({tag}) {h.request_id}: "
+                      f"reason={h.finish_reason} fleet={h.tokens} "
+                      f"solo={want}")
+                ok = False
+            if streamed.get(h.request_id, []) != h.tokens:
+                print(f"selftest-crosshost FAIL ({tag}) {h.request_id}: "
+                      f"streamed {streamed.get(h.request_id)} != handle "
+                      f"{h.tokens} (duplicate or lost emission)")
+                ok = False
+        return ok
+
+    def mid_decode_on(frontend, host):
+        for c in frontend.handles.values():
+            if (c.current_host == host and not c.finished
+                    and len(c.tokens) >= 1):
+                return c
+        return None
+
+    def shutdown(agents):
+        for host in sorted(agents):
+            try:
+                agents[host].router.supervisor.shutdown_all()
+            except Exception as e:  # dead hosts already reaped
+                print(f"selftest-crosshost: {host} shutdown: {e!r}")
+
+    def samples(page, family):
+        return {tuple(sorted(labels.items())): value
+                for name, labels, value in parse_prometheus(page)["samples"]
+                if name == family}
+
+    # -- Leg A: SIGKILL a whole host mid-decode -----------------------
+    frontend, agents, streamed = build_mesh("kill")
+    pids = {h: [r.backend.pid for r in a.router.supervisor.replicas]
+            for h, a in agents.items()}
+    print(f"selftest-crosshost workers: {pids} (spill: {spill_root})")
+    handles = [frontend.submit(Request(prompt=p, max_new_tokens=max_new))
+               for p in prompts]
+    victim = None
+    for _ in range(20000):
+        frontend.step()
+        victim = mid_decode_on(frontend, "host0")
+        if victim is not None:
+            break
+    if victim is None:
+        print("selftest-crosshost FAIL (kill): nothing mid-decode on "
+              "host0")
+        rc = 1
+    else:
+        agents["host0"].kill_host()  # SIGKILLs every host0 worker
+        try:
+            frontend.run_until_drained(max_steps=200000)
+        except RuntimeError as e:
+            print(f"selftest-crosshost FAIL (kill): {e}")
+            rc = 1
+        ok_kill = check_parity("kill", handles, streamed)
+        rows = [row for a in agents.values()
+                for row in a.router.supervisor.recovery_log
+                if row.get("path") == "crosshost"]
+        fo = samples(frontend.fleet_metrics_page(),
+                     "mingpt_fleet_crosshost_failovers_total")
+        fo_host0 = fo.get((("from_host", "host0"),), 0)
+        checks_a = [
+            ("streams stayed exact across the host death", ok_kill),
+            ("the frontend declared host0 failed",
+             "host0" in frontend.summary()["declared_failed"]),
+            ("the victim request failed over cross-host",
+             victim.recovery_s is not None
+             and len(set(victim.hosts)) >= 2),
+            ("the adopting host logged path=crosshost recovery rows",
+             bool(rows) and all(r["recovery_s"] > 0 for r in rows)),
+            ("the failover counter names host0", fo_host0 >= 1),
+        ]
+        for what, ok in checks_a:
+            if not ok:
+                print(f"selftest-crosshost FAIL (kill): {what}")
+                rc = 1
+        if victim.recovery_s is not None:
+            print(f"selftest-crosshost host-death recovery: "
+                  f"{victim.recovery_s:.3f}s over hosts {victim.hosts}")
+    shutdown(agents)
+
+    # -- Leg B: paced migration under slow_link -----------------------
+    bytes_per_s = 1e6
+    link_delay = 0.02
+    frontend, agents, streamed = build_mesh(
+        "paced",
+        net_faults=f"slow_link:every=1:match=host0->host1:"
+                   f"delay={link_delay}",
+        paced_bytes_per_s=bytes_per_s)
+    handles = [frontend.submit(Request(prompt=p, max_new_tokens=max_new))
+               for p in prompts]
+    for _ in range(20000):
+        frontend.step()
+        if mid_decode_on(frontend, "host0") is not None:
+            break
+    t0 = time.monotonic()
+    report = frontend.migrate_crosshost("host0", "host1")
+    elapsed = time.monotonic() - t0
+    print(f"selftest-crosshost migration: {json.dumps(report)}")
+    try:
+        frontend.run_until_drained(max_steps=200000)
+    except RuntimeError as e:
+        print(f"selftest-crosshost FAIL (paced): {e}")
+        rc = 1
+    ok_paced = check_parity("paced", handles, streamed)
+    budget = report["bytes"] / bytes_per_s + link_delay * report["chunks"]
+    xb = samples(frontend.fleet_metrics_page(),
+                 "mingpt_fleet_xfer_bytes_total")
+    shipped = xb.get((("paced", "true"),), 0)
+    checks_b = [
+        ("migration shipped state (outcome=ok)",
+         report["outcome"] == "ok"),
+        ("migrated streams stayed token-exact", ok_paced),
+        ("the source replica retired with the requeue exit code",
+         report["src_exit_code"] == 75),
+        ("the wall transfer respected the bandwidth budget "
+         f"(transfer_s={report['transfer_s']:.3f}s budget="
+         f"{budget:.3f}s wall={elapsed:.3f}s)",
+         report["transfer_s"] >= 0.95 * budget
+         and elapsed >= 0.95 * budget),
+        ("pacing waited, not stalled (within 2s of budget)",
+         report["transfer_s"] <= budget + 2.0),
+        ("the paced byte counter saw the transfer",
+         shipped >= report["bytes"]),
+    ]
+    for what, ok in checks_b:
+        if not ok:
+            print(f"selftest-crosshost FAIL (paced): {what}")
+            rc = 1
+
+    # -- Leg C: tampered frame -> typed reject + counter --------------
+    doc = envelope("heartbeat", host="host0", epoch=0, seq=10_000)
+    agents["host0"].auth.sign(doc)
+    doc["seq"] = 10_001  # tampered after signing
+    resp = json.loads(agents["host1"].handle_host(
+        "/host/heartbeat", json.dumps(doc, sort_keys=True).encode()))
+    rejects = samples(agents["host1"].router.fleet_metrics_page(),
+                      "mingpt_fleet_auth_rejects_total")
+    bad_mac = sum(v for labels, v in rejects.items()
+                  if dict(labels).get("reason") == "bad_mac")
+    checks_c = [
+        ("tampered frame rejected with the typed error",
+         resp.get("kind") == "error"
+         and resp.get("error") == "BadSignature"),
+        ("the bad_mac reject counter incremented", bad_mac >= 1),
+    ]
+    for what, ok in checks_c:
+        if not ok:
+            print(f"selftest-crosshost FAIL (auth): {what}")
+            rc = 1
+    print(f"selftest-crosshost auth: reject={resp.get('error')} "
+          f"bad_mac={bad_mac}")
+    shutdown(agents)
+    print("selftest-crosshost", "PASSED" if rc == 0 else "FAILED")
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     if args.selftest_procfleet:
         return selftest_procfleet(args)
     if args.selftest_standby:
         return selftest_standby(args)
+    if args.selftest_crosshost:
+        return selftest_crosshost(args)
     if args.selftest_sharded:
         return selftest_sharded(args)
     if args.selftest_attrib:

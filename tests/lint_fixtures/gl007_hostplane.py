@@ -1,0 +1,55 @@
+"""GL007 fixtures — wall-clock shapes in the cross-host hostplane.
+
+Positives: a wall read deciding a heartbeat deadline; a wall sleep
+pacing a token-bucket transfer; ``time.monotonic()`` driving a
+connect-retry backoff.
+Suppressed: one wall read stamping a transfer report, inline disable.
+Negatives: the hostplane-approved shapes — the peer-state ladder and
+the bucket refill both read the injected fleet clock, pacing *advances*
+that clock instead of sleeping, and the bounded socket retry takes an
+injectable sleep as a default argument (a reference, never a call —
+the ``RetryPolicy.sleep`` idiom again).
+"""
+import time
+
+
+def heartbeat_deadline_bad(last_contact, suspect_after_s):
+    # a wall read deciding suspect/quarantined/dead makes the ladder
+    # unreplayable — one slow test machine flaps a healthy peer
+    return time.monotonic() - last_contact >= suspect_after_s  # expect: GL007
+
+
+def paced_send_bad(nbytes, bytes_per_s):
+    time.sleep(nbytes / bytes_per_s)  # expect: GL007
+
+
+def connect_backoff_bad(attempt, backoff_s):
+    deadline = time.time() + backoff_s * (2 ** attempt)  # expect: GL007
+    return deadline
+
+
+def transfer_report_suppressed():
+    return time.perf_counter()  # graftlint: disable=GL007
+
+
+def ladder_rung(clock, last_contact, suspect_after_s):
+    # clean: elapsed silence is measured on the injected fleet clock,
+    # so two partition drills degrade a peer on the same virtual tick
+    return clock.now() - last_contact >= suspect_after_s
+
+
+def token_bucket_refill(clock, tokens, last_refill, bytes_per_s, burst):
+    # clean: the bucket refills from the same injected clock it waits
+    # on — bandwidth budgets are virtual-seconds math, not wall time
+    return min(burst, tokens + (clock.now() - last_refill) * bytes_per_s)
+
+
+def paced_wait(clock, deficit_bytes, bytes_per_s):
+    # clean: pacing ADVANCES the injected clock rather than sleeping;
+    # on a virtual clock the transfer takes exactly bytes/rate seconds
+    clock.advance(deficit_bytes / bytes_per_s)
+    return clock.now()
+
+
+def bounded_connect_retry(sleep=time.sleep):  # clean: reference, not call
+    return sleep

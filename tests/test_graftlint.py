@@ -383,6 +383,29 @@ def test_procfleet_package_clean_under_clock_rule():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_hostplane_module_clean_under_clock_rule():
+    """ISSUE 19: the cross-host control plane is deterministic only
+    because heartbeat deadlines, the token-bucket pacing budget, and
+    transfer retries all live on the injected fleet clock — the module
+    imports no ``time`` at all (pacing *advances* the clock; against a
+    wall clock the caller injects ``sleep``). Pinned with its own
+    explicit scope entry AND asserted clock-clean outright — no
+    suppressions, no baseline entries. The hazard and approved shapes
+    are pinned by the gl007_hostplane.py fixture."""
+    path = os.path.join(REPO, "mingpt_distributed_tpu", "serving",
+                        "procfleet", "hostplane.py")
+    cfg = Engine(select=["GL007"], root=REPO).config
+    assert "serving/procfleet/hostplane.py" in cfg.clock_paths
+    rel = os.path.relpath(path, REPO)
+    assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    with open(path, "r", encoding="utf-8") as fh:
+        source = fh.read()
+    assert "import time" not in source  # stronger than lint: no module at all
+    res = Engine(select=["GL007"], root=REPO).run([path])
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+
 def test_attribution_module_clean_under_clock_and_name_rules():
     """ISSUE 13: the attribution ledger's byte-identical-report
     guarantee (two VirtualClock serving runs must dump the same

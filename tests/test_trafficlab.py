@@ -462,3 +462,84 @@ def test_sweep_spec_validation():
     with pytest.raises(ValueError):
         SweepSpec(slo="vibes<=0.5").validate()
     SweepSpec().validate()
+
+
+# -- cross-host axis (ISSUE 19) -------------------------------------------
+
+# host0 cut off from its peers (both directions) at the very first
+# heartbeat crossing, healing 0.1 virtual seconds later — long past the
+# quarantine threshold (5x the 5 ms heartbeat), so the survivors
+# declare host0 failed and adopt its in-flight requests mid-partition
+_CROSSHOST_CHAOS = ";".join(
+    f"partition:nth=1:match={a}->{b}:delay=0.1"
+    for a, b in [("host0", "host1"), ("host0", "host2"),
+                 ("host1", "host0"), ("host2", "host0")])
+
+
+def _crosshost_spec(**overrides):
+    base = dict(arrival="poisson:rate=200.0", ladder=(1.0,),
+                policies=("fifo", "edf"), n_requests=10, seed=3,
+                n_replicas=1, n_slots=2, n_hosts=3,
+                heartbeat_interval_s=0.005,
+                slo="ttft_p95<=60,error_rate<=0.5",
+                recovery_slo_s=30.0,
+                net_chaos_spec=_CROSSHOST_CHAOS)
+    base.update(overrides)
+    return SweepSpec(**base)
+
+
+def test_crosshost_partition_sweep_recovers_and_is_byte_identical(
+        cfg_params):
+    """ISSUE 19: an EDF-vs-FIFO sweep replayed on a 3-host loopback
+    mesh under a partition that cuts host0 off mid-decode. The report
+    must strict-validate with every offered request accounted for, at
+    least one request must ride a cross-host failover (graded by the
+    recovery-tail objective), and two runs of the identical spec must
+    serialize byte-identically — network chaos composes with the
+    sweep's replayability contract."""
+    cfg, params = cfg_params
+    spec = _crosshost_spec()
+    report = run_sweep(params, cfg, spec, mix=traffic_cli.selftest_mix())
+    assert validate_traffic_report(json.loads(dump_report(report)),
+                                   strict=False) == []
+    assert report["net_chaos_spec"] == _CROSSHOST_CHAOS
+    assert report["fleet"]["n_hosts"] == 3
+    assert report["slo_spec"] == spec.effective_slo()
+
+    cells = report["rungs"][0]["policies"]
+    for policy in ("fifo", "edf"):
+        cell = cells[policy]
+        accounted = (cell["completed"] + cell["shed"] + cell["expired"]
+                     + cell["errors"])
+        assert accounted == 10 and cell["completed"] > 0
+
+    # the partition produced real cross-host failover rows, and the
+    # recovery-tail objective graded them (virtual failover is fast)
+    recovered_cells = [c for c in cells.values() if c["recovered"] >= 1]
+    assert recovered_cells, "no request crossed hosts — vacuous drill"
+    for cell in recovered_cells:
+        row = next(r for r in cell["slo"]["objectives"]
+                   if r["name"] == "recovery_p99")
+        assert row["observed"] is not None and row["observed"] > 0
+        assert row["pass"] is True
+
+    # replayability: same (seed, spec) -> byte-identical report
+    report2 = run_sweep(params, cfg, _crosshost_spec(),
+                        mix=traffic_cli.selftest_mix())
+    assert dump_report(report) == dump_report(report2)
+
+
+def test_sweep_spec_crosshost_validation():
+    with pytest.raises(ValueError):  # chaos needs a mesh
+        SweepSpec(net_chaos_spec=_CROSSHOST_CHAOS).validate()
+    with pytest.raises(ValueError):  # thread-fleet chaos axis
+        SweepSpec(n_hosts=3, chaos_spec="crash:nth=1").validate()
+    with pytest.raises(ValueError):  # host mesh sheds on lost quorum
+        SweepSpec(n_hosts=3, shed_watermark=4).validate()
+    with pytest.raises(ValueError):
+        SweepSpec(n_hosts=0).validate()
+    with pytest.raises(ValueError):
+        SweepSpec(n_hosts=2, heartbeat_interval_s=0.0).validate()
+    with pytest.raises(ValueError):  # injector grammar checked up front
+        SweepSpec(n_hosts=2, net_chaos_spec="gremlins:nth=1").validate()
+    SweepSpec(n_hosts=3, net_chaos_spec=_CROSSHOST_CHAOS).validate()

@@ -77,6 +77,13 @@ def build_argparser() -> argparse.ArgumentParser:
     p.add_argument("--chaos-spec", default=None,
                    help="ServingFaultInjector spec: grade the same sweep "
                         "under injected faults")
+    p.add_argument("--hosts", type=int, default=1,
+                   help="> 1 runs every cell on the loopback cross-host "
+                        "mesh (--replicas becomes per-host)")
+    p.add_argument("--net-chaos-spec", default=None,
+                   help="NetworkFaultInjector spec (partition / "
+                        "drop_frame / slow_link / host_kill) over the "
+                        "host mesh; needs --hosts >= 2")
     p.add_argument("--shed-watermark", type=int, default=None,
                    help="fleet-wide queue depth that sheds new arrivals")
     p.add_argument("--prefix-cache-mb", type=float, default=0.0,
@@ -122,6 +129,8 @@ def _sweep_spec(args):
         slo=args.slo,
         knee_objective=args.knee_objective,
         chaos_spec=args.chaos_spec,
+        n_hosts=args.hosts,
+        net_chaos_spec=args.net_chaos_spec,
         shed_watermark=args.shed_watermark,
         prefix_cache_mb=args.prefix_cache_mb,
     )
