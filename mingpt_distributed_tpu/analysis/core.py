@@ -128,6 +128,11 @@ class Config:
         "telemetry/flightrec.py",
         "telemetry/attribution.py",
         "trafficlab/",
+        # the control plane decides *when* to scale from ControlSnapshot
+        # timestamps sampled off the router's injected clock; a stray
+        # time.time() in the governor would make autoscaled sweeps
+        # non-replayable, so the whole package is in scope
+        "control/",
     )
     # GL007: time.time() results bound to these names are telemetry
     # timestamps (epoch stamps on records), not scheduling decisions

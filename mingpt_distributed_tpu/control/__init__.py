@@ -1,0 +1,60 @@
+"""Control plane (ISSUE 20): signals → cost → decisions → actuation.
+
+The traffic lab can *locate* the knee; this package *acts* on it:
+
+* :mod:`signals` — one typed, injected-clock snapshot of fleet state
+  per control tick (rolling TTFT/deadline windows, queue depth, shed
+  counts, replica readiness, HBM headroom).
+* :mod:`cost` — the per-policy cost model (deadline misses per token
+  served + shed-weighted goodput), one implementation for trafficlab
+  cells and live counters.
+* :mod:`controller` — the SLO autoscaler: hysteresis + cooldown over
+  the signals, actuating replica count / speculation / prefill chunk /
+  shed watermark, every decision a ``mingpt-control/1`` JSONL row.
+* :mod:`importer` — recorded ``mingpt-trace/1`` logs → ``recorded:``
+  arrival specs, so sweeps replay production-shaped load byte-exactly.
+
+Import-light by design: no jax at import time — the control plane
+reasons about the fleet through its telemetry, never through device
+state.
+"""
+
+from mingpt_distributed_tpu.control.controller import (
+    CONTROL_SCHEMA,
+    ControllerConfig,
+    HysteresisGovernor,
+    SLOAutoscaler,
+    parse_controller_spec,
+    render_control_log,
+)
+from mingpt_distributed_tpu.control.cost import (
+    compute_cost,
+    cost_from_cell,
+    cost_from_signals,
+)
+from mingpt_distributed_tpu.control.importer import (
+    import_trace_arrivals,
+    trace_arrival_times,
+)
+from mingpt_distributed_tpu.control.signals import (
+    ControlSnapshot,
+    FleetSignalsView,
+    SignalSampler,
+)
+
+__all__ = [
+    "CONTROL_SCHEMA",
+    "ControlSnapshot",
+    "ControllerConfig",
+    "FleetSignalsView",
+    "HysteresisGovernor",
+    "SLOAutoscaler",
+    "SignalSampler",
+    "compute_cost",
+    "cost_from_cell",
+    "cost_from_signals",
+    "import_trace_arrivals",
+    "parse_controller_spec",
+    "render_control_log",
+    "trace_arrival_times",
+]

@@ -38,6 +38,7 @@ from typing import Any, List, Sequence, Tuple
 __all__ = [
     "AdmissionPolicy",
     "FifoPolicy",
+    "HealthAwarePolicy",
 ]
 
 
@@ -87,3 +88,60 @@ class FifoPolicy(AdmissionPolicy):
 
     def select(self, queue: Sequence[Any], now: float) -> int:
         return 0
+
+
+class HealthAwarePolicy(AdmissionPolicy):
+    """Admission that consults live fleet health (ISSUE 20): FIFO while
+    every routable replica passes its health gates, earliest-deadline-
+    first the moment any is degraded (queue over watermark, ITL p99
+    over SLO, recompiles — or nothing routable at all).
+
+    The rationale: under healthy capacity, arrival order is the fair
+    and cache-friendly order; once the fleet is degraded, head-of-line
+    blocking starts costing deadline misses, so ordering flips to
+    honour urgency. ``bind`` attaches the signals seam
+    (:class:`~mingpt_distributed_tpu.control.signals.FleetSignalsView`
+    or anything with ``degraded() -> bool``) after the router exists —
+    trafficlab's runner binds it per cell; unbound the policy is plain
+    FIFO, so it degrades safely in a solo server.
+
+    The degraded bit is re-read per ``select``/``order`` call, never
+    mid-sort: fleet state cannot change inside one ordering pass, so
+    every key in a pass comes from the same regime and stays a total
+    order."""
+
+    name = "health"
+
+    def __init__(self):
+        self._signals = None
+
+    def bind(self, signals) -> None:
+        self._signals = signals
+
+    def _degraded(self) -> bool:
+        return self._signals is not None and self._signals.degraded()
+
+    def _key(self, handle: Any, position: int, degraded: bool) -> Tuple:
+        if not degraded:
+            return (0, 0, 0.0, position)
+        deadline = getattr(handle, "deadline", None)
+        if deadline is None:
+            return (1, 1, 0.0, position)
+        return (1, 0, float(deadline), position)
+
+    def sort_key(self, handle: Any, position: int, now: float) -> Tuple:
+        return self._key(handle, position, self._degraded())
+
+    def select(self, queue: Sequence[Any], now: float) -> int:
+        degraded = self._degraded()
+        if not degraded:
+            return 0
+        return min(
+            range(len(queue)),
+            key=lambda i: self._key(queue[i], i, degraded))
+
+    def order(self, handles: Sequence[Any], now: float) -> List[int]:
+        degraded = self._degraded()
+        return sorted(
+            range(len(handles)),
+            key=lambda i: self._key(handles[i], i, degraded))

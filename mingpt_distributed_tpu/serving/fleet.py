@@ -252,6 +252,12 @@ class Replica:
     """One supervised ``InferenceServer`` with its own skewed clock and
     the injector's fault points wired into its lifecycle."""
 
+    #: control-plane scale-down flag: a draining replica keeps stepping
+    #: (its in-flight streams finish in place — never re-routed) but the
+    #: router stops placing new work on it; once idle the controller
+    #: retires it through ``ReplicaSupervisor.retire_replica``
+    draining = False
+
     def __init__(
         self,
         name: str,
@@ -287,6 +293,7 @@ class Replica:
         mid-round may have left host-side slot state half-updated."""
         self.server = self._spawn()
         self.state = "ready"
+        self.draining = False
 
     def submit(self, request: Request) -> RequestHandle:
         if self.injector is not None:
@@ -308,9 +315,13 @@ class Replica:
         """Readiness from signals the replica already exports — the same
         numbers a /healthz endpoint would gate on."""
         reasons: List[str] = []
+        if self.state == "drained":
+            return ReplicaHealth(False, ["drained"])
         if self.state != "ready":
             reasons.append("crashed")
             return ReplicaHealth(False, reasons)
+        if self.draining:
+            reasons.append("draining")
         if len(self.server.queue) > self.queue_high_watermark:
             reasons.append("queue_depth")
         if self.itl_slo_s is not None:
@@ -352,6 +363,12 @@ class ReplicaSupervisor:
         self.registry = registry if registry is not None else MetricsRegistry()
         self.max_restarts = max_restarts
         self.restart_backoff_s = restart_backoff_s
+        # kept for control-plane scale-up: spawn_replica builds late
+        # replicas with the same factory/health gates as the first N
+        self._server_factory = server_factory
+        self.queue_high_watermark = queue_high_watermark
+        self.itl_slo_s = itl_slo_s
+        self._next_index = n_replicas
         self.replicas = [
             self.replica_cls(
                 f"replica{i}", i, server_factory, self.clock, injector,
@@ -454,6 +471,43 @@ class ReplicaSupervisor:
         liveness ladder."""
         return []
 
+    # -- control-plane actuation (ISSUE 20) ----------------------------
+    def _make_replica(self, name: str, index: int) -> Replica:
+        """Construction hook for late (scale-up) replicas — subclasses
+        pre-configure isolation wiring (procfleet sets the process
+        injector and standby pool BEFORE the first spawn, so a scale-up
+        can adopt a warm spare)."""
+        return self.replica_cls(
+            name, index, self._server_factory, self.clock, self.injector,
+            queue_high_watermark=self.queue_high_watermark,
+            itl_slo_s=self.itl_slo_s)
+
+    def spawn_replica(self) -> Replica:
+        """Grow the fleet by one replica (controller scale-up). Indices
+        never recycle — a drained replica's name stays retired — and the
+        newcomer gets the same per-replica gauge/counter initialisation
+        as the construction-time set."""
+        idx = self._next_index
+        self._next_index += 1
+        rep = self._make_replica(f"replica{idx}", idx)
+        self.replicas.append(rep)
+        self._up.labels(replica=rep.name).set(1)
+        self._healthy.labels(replica=rep.name).set(1)
+        self._crashes.labels(replica=rep.name).inc(0)
+        self._restarts.labels(replica=rep.name).inc(0)
+        return rep
+
+    def retire_replica(self, replica: Replica) -> None:
+        """Terminal, graceful exit (controller scale-down, post-drain):
+        the replica leaves the routable set for good — no restart is
+        scheduled and its gauges read down. The in-process fleet has no
+        process to reap; procfleet's override also shuts the worker
+        down and records its exit code."""
+        replica.state = "drained"
+        self._restart_due.pop(replica.name, None)
+        self._up.labels(replica=replica.name).set(0)
+        self._healthy.labels(replica=replica.name).set(0)
+
     def recovery_info(self, name: str) -> Optional[Dict]:
         """The most recent respawn post-mortem for ``name`` (None before
         its first recovery) — the router stamps ``failover`` trace
@@ -507,6 +561,7 @@ class FleetHandle:
     trace: Optional[TraceContext] = None  # root trace context (ISSUE 10)
     fault_at: Optional[float] = None     # fleet clock when a fault hit us
     recovery_s: Optional[float] = None   # fault -> first NEW token after it
+    first_token_at: Optional[float] = None  # fleet clock at first emit (TTFT)
 
 
 class Router:
@@ -546,6 +601,13 @@ class Router:
         # rides on the attempt Request into the replica scheduler.
         self.trace_recorder = trace_recorder
         self.flight = flight
+        # control plane (ISSUE 20): an attached SLOAutoscaler gets one
+        # on_round() per scheduling round; on_finish feeds its signal
+        # windows one call per finished fleet request
+        self.controller = None
+        self.on_finish: Optional[Callable[[FleetHandle, str], None]] = None
+        self.breaker_failure_threshold = breaker_failure_threshold
+        self.breaker_reset_s = breaker_reset_s
         self._shed_ids = itertools.count()
         if flight is not None:
             # per-replica registry snapshots for crash dumps — lazy
@@ -633,6 +695,28 @@ class Router:
                 lambda grown, name=rep.name: self.flight.dump(
                     "watchdog_recompile", replica=name, families=grown))
 
+    def add_replica(self, rep: Replica) -> None:
+        """Wire a freshly spawned (scale-up) replica into the routing
+        tier: breaker, streaming emitter + trace recorder, per-replica
+        gauges, and the flight recorder's lazy metrics provider —
+        everything ``__init__`` did for the construction-time set."""
+        self.breakers[rep.name] = CircuitBreaker(
+            self.clock.now, self.breaker_failure_threshold,
+            self.breaker_reset_s)
+        self._wire_replica(rep)
+        if self.flight is not None:
+            self.flight.metrics_providers.setdefault(
+                rep.name,
+                (lambda r=rep: render_prometheus(r.server.metrics.registry)))
+        self._breaker_gauge.labels(replica=rep.name).set(
+            CircuitBreaker.CLOSED)
+
+    def shed_counts(self) -> Dict[str, int]:
+        """Cumulative refused admissions by reason — the control
+        plane's shed signal (same numbers ``summary()`` reports)."""
+        return {labels["reason"]: int(child.value)
+                for labels, child in self._rejected.children()}
+
     def _make_emitter(self, replica_name: str):
         def emit(rh: RequestHandle, token: int) -> None:
             entry = self._attempts.get((replica_name, rh.request_id))
@@ -647,6 +731,8 @@ class Router:
                 self._dup_suppressed.inc()
                 return
             fh.tokens.append(token)
+            if fh.first_token_at is None:
+                fh.first_token_at = self.clock.now()
             if fh.fault_at is not None:
                 # first NEW caller-visible token since a fault hit this
                 # request: the recovery tail the chaos sweeps grade
@@ -675,7 +761,8 @@ class Router:
         alive as the last-resort tier. Deterministic: stable sorts,
         index order breaks ties."""
         admitted = [rep for rep in self.supervisor.ready_replicas()
-                    if self.breakers[rep.name].allow()]
+                    if not rep.draining
+                    and self.breakers[rep.name].allow()]
         if not admitted:
             return []
         pref_idx = self._affinity_index(fh.request.prompt)
@@ -801,7 +888,8 @@ class Router:
                     reason="deadline",
                     retry_after_s=est)
         if not any(self.breakers[rep.name].allow()
-                   for rep in self.supervisor.ready_replicas()):
+                   for rep in self.supervisor.ready_replicas()
+                   if not rep.draining):
             self._rejected.labels(reason="breaker_open").inc()
             self._trace_shed(request, "breaker_open", now)
             raise ShedError(
@@ -847,6 +935,8 @@ class Router:
         fh.finish_reason = reason
         outcome = "completed" if reason in ("length", "eos") else reason
         self._requests_total.labels(outcome=outcome).inc()
+        if self.on_finish is not None:
+            self.on_finish(fh, outcome)
         if self.trace_recorder is not None and fh.trace is not None:
             attrs = {"replica": fh.replica,
                      "duplicates_suppressed": fh.duplicates_suppressed}
@@ -1028,6 +1118,12 @@ class Router:
                 continue
             del self._attempts[key]
             self._resolve_finished(key[0], fh, rh, crashed=False)
+
+        if self.controller is not None:
+            # control tick AFTER outcomes reconcile (its signal windows
+            # see this round's finishes) and BEFORE gauges/clock, so an
+            # actuation lands in the same round's exported state
+            self.controller.on_round()
 
         self._update_gauges()
         self.clock.tick()

@@ -856,6 +856,33 @@ class ProcessSupervisor(ReplicaSupervisor):
                 self._liveness.pop(rep.name, None)
         return escalated
 
+    # -- control-plane actuation (ISSUE 20) ----------------------------
+    def _make_replica(self, name: str, index: int):
+        """Scale-up construction with the process wiring in place
+        BEFORE the first spawn: the injector so chaos reaches the
+        newcomer, and the standby pool so a scale-up adopts a hot spare
+        (warm path) instead of paying a cold worker spawn when one is
+        waiting."""
+        rep = self.replica_cls.__new__(self.replica_cls)
+        rep.pinj = self.process_injector
+        if self.standby_pool is not None:
+            rep.standby_pool = self.standby_pool
+        rep.__init__(
+            name, index, self._server_factory, self.clock, self.injector,
+            queue_high_watermark=self.queue_high_watermark,
+            itl_slo_s=self.itl_slo_s)
+        return rep
+
+    def spawn_replica(self):
+        rep = super().spawn_replica()
+        self._proc_restarts.labels(replica=rep.name).inc(0)
+        if self.standby_pool is not None:
+            # backfill after a possible adoption, same ordering contract
+            # as poll_restarts: the spawn cost lands here, not on the
+            # scale-up decision's latency
+            self.standby_pool.fill()
+        return rep
+
     def retire_replica(self, replica) -> Dict[str, Any]:
         """Graceful, terminal shutdown (post-migration): the replica
         leaves the routable set for good — no restart is scheduled, and

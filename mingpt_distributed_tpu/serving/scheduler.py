@@ -249,6 +249,12 @@ class InferenceServer:
             raise ValueError("spec_k >= 1 requires draft_params/draft_cfg")
         else:
             self.spec = None
+        # control-plane gate (ISSUE 20): round-level speculation on/off.
+        # Gating is token-exact — verify guarantees parity, and a gated
+        # round's draft rows merely go stale (advisory state), so the
+        # autoscaler can trade draft compute for aggregate throughput
+        # mid-stream without touching emitted tokens.
+        self.spec_enabled = True
         self.metrics = metrics or ServingMetrics(
             n_slots, log_every=log_every, registry=registry)
         # disabled-by-default tracer: span() returns a shared no-op, so the
@@ -719,7 +725,7 @@ class InferenceServer:
                 # near-window tails keep the plain one-token step (parity
                 # and key-folding semantics unchanged on both paths)
                 spec_slots: List[int] = []
-                if self.spec is not None:
+                if self.spec is not None and self.spec_enabled:
                     spec_slots = [s for s in active if self.spec.eligible(
                         bool(st.do_sample[s]), int(st.positions[s]))]
                 plain = [s for s in active if s not in spec_slots]

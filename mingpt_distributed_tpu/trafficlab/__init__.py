@@ -12,7 +12,9 @@ are virtual-timestamp *data* sampled once from ``(seed, spec)``, so a
 pins this), finishes in seconds, and is byte-identically replayable.
 
 * ``arrivals.py`` — seeded arrival processes (Poisson, bursty on/off,
-  ramp) emitting absolute virtual timestamps via Lewis–Shedler thinning.
+  ramp) emitting absolute virtual timestamps via Lewis–Shedler
+  thinning, plus ``recorded:`` literal replay of imported traces
+  (control/importer.py emits these from mingpt-trace/1 logs).
 * ``workloads.py`` — multi-tenant mixes (chat / completion /
   long-context / shared-prefix families) rendered into concrete
   ``Request``s; shared-prefix pools exercise the PrefixKVStore.
@@ -33,6 +35,7 @@ from mingpt_distributed_tpu.trafficlab.arrivals import (
     BurstySpec,
     PoissonSpec,
     RampSpec,
+    RecordedSpec,
     arrival_times,
     format_arrival_spec,
     parse_arrival_spec,
@@ -64,6 +67,7 @@ __all__ = [
     "POLICIES",
     "PoissonSpec",
     "RampSpec",
+    "RecordedSpec",
     "SweepSpec",
     "TRAFFIC_SCHEMA",
     "TenantSpec",

@@ -16,16 +16,25 @@ the fault-spec style used elsewhere in the repo::
     poisson:rate=50
     bursty:rate_on=200:rate_off=5:period=2.0:duty=0.25
     ramp:rate0=10:rate1=400:duration=20
+    recorded:times=0.0;0.012;0.5;1.25
 
 ``scaled(f)`` multiplies every intensity by ``f`` — the sweep ladder is
 "the same shape, offered harder".
+
+``recorded:`` (ISSUE 20) is the replay kind the trace importer
+(control/importer.py) emits: its times are not sampled at all —
+``arrival_times`` returns them verbatim, so a sweep over a recorded
+spec replays production-shaped load byte-identically. ``scaled(f)``
+divides every timestamp by ``f`` (gap compression), which is the same
+"shape preserved, offered harder" ladder semantics as the synthetic
+kinds.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import zlib
-from typing import Dict, List, Union
+from typing import Dict, List, Tuple, Union
 
 import numpy as np
 
@@ -34,6 +43,7 @@ __all__ = [
     "BurstySpec",
     "PoissonSpec",
     "RampSpec",
+    "RecordedSpec",
     "arrival_times",
     "format_arrival_spec",
     "parse_arrival_spec",
@@ -137,7 +147,65 @@ class RampSpec:
                 f":duration={_fmt(self.duration)}")
 
 
-ArrivalSpec = Union[PoissonSpec, BurstySpec, RampSpec]
+@dataclasses.dataclass(frozen=True)
+class RecordedSpec:
+    """Literal arrival times imported from a ``mingpt-trace/1`` log
+    (control/importer.py). Nothing is sampled: ``arrival_times``
+    returns these timestamps exactly (plus ``start``), so the seed is
+    irrelevant and two renders are trivially identical."""
+
+    times: Tuple[float, ...]
+
+    kind = "recorded"
+
+    def __post_init__(self):
+        if not self.times:
+            raise ValueError("recorded spec needs at least one time")
+        prev = None
+        for t in self.times:
+            t = float(t)
+            if t < 0.0:
+                raise ValueError(f"recorded time {t} < 0")
+            if prev is not None and t < prev:
+                raise ValueError(
+                    f"recorded times must be non-decreasing "
+                    f"({t} after {prev})")
+            prev = t
+
+    def duration(self) -> float:
+        return float(self.times[-1] - self.times[0])
+
+    def rate_at(self, t: float) -> float:
+        """Arrivals inside the 1-second window centred on ``t`` —
+        descriptive only (generation never thins a recorded spec)."""
+        return float(sum(1 for x in self.times if t - 0.5 <= x < t + 0.5))
+
+    def peak_rate(self) -> float:
+        """Busiest 1-second window (two-pointer sweep over the sorted
+        times) — at least 1.0, so shared validation holds."""
+        best, lo = 1, 0
+        for hi in range(len(self.times)):
+            while self.times[hi] - self.times[lo] > 1.0:
+                lo += 1
+            best = max(best, hi - lo + 1)
+        return float(best)
+
+    def mean_rate(self) -> float:
+        dur = self.duration()
+        if dur <= 0.0:
+            return float(len(self.times))
+        return (len(self.times) - 1) / dur
+
+    def scaled(self, factor: float) -> "RecordedSpec":
+        if factor <= 0.0:
+            raise ValueError(f"scale factor must be > 0, got {factor}")
+        return RecordedSpec(times=tuple(t / factor for t in self.times))
+
+    def to_string(self) -> str:
+        return "recorded:times=" + ";".join(_fmt(t) for t in self.times)
+
+
+ArrivalSpec = Union[PoissonSpec, BurstySpec, RampSpec, RecordedSpec]
 
 _SPEC_FIELDS = {
     "poisson": ("rate",),
@@ -153,6 +221,20 @@ def parse_arrival_spec(text: str) -> ArrivalSpec:
     if not parts:
         raise ValueError("empty arrival spec")
     kind = parts[0].strip().lower()
+    if kind == "recorded":
+        # different value grammar: one 'times' field holding a
+        # semicolon-separated timestamp list (colons are field seps)
+        if len(parts) != 2 or not parts[1].startswith("times="):
+            raise ValueError(
+                "recorded spec must be recorded:times=t0;t1;... "
+                f"(got {text!r})")
+        body = parts[1][len("times="):]
+        try:
+            times = tuple(float(v) for v in body.split(";") if v != "")
+        except ValueError:
+            raise ValueError(
+                f"non-numeric timestamp in recorded spec {text!r}")
+        return RecordedSpec(times=times)
     if kind not in _SPEC_FIELDS:
         raise ValueError(
             f"unknown arrival kind {kind!r} (want one of "
@@ -209,8 +291,12 @@ def format_arrival_spec(spec: ArrivalSpec) -> str:
 def spec_to_json(spec: ArrivalSpec) -> Dict[str, object]:
     """JSON-embeddable description for the mingpt-traffic/1 report."""
     out: Dict[str, object] = {"kind": spec.kind}
-    for field in _SPEC_FIELDS[spec.kind]:
-        out[field] = float(getattr(spec, field))
+    if isinstance(spec, RecordedSpec):
+        out["n"] = len(spec.times)
+        out["duration"] = spec.duration()
+    else:
+        for field in _SPEC_FIELDS[spec.kind]:
+            out[field] = float(getattr(spec, field))
     out["spec"] = spec.to_string()
     out["mean_rate"] = float(spec.mean_rate())
     out["peak_rate"] = float(spec.peak_rate())
@@ -234,6 +320,13 @@ def arrival_times(spec: ArrivalSpec, n: int, seed: int,
     """
     if n <= 0:
         return []
+    if isinstance(spec, RecordedSpec):
+        # replay, never sample: the recorded gaps ARE the trace
+        if n > len(spec.times):
+            raise ValueError(
+                f"recorded spec holds {len(spec.times)} arrivals, "
+                f"{n} requested — size n_requests to the trace")
+        return [float(start) + float(t) for t in spec.times[:n]]
     rng = np.random.RandomState(_stream_seed(seed, spec.to_string()))
     lam_max = spec.peak_rate()
     out: List[float] = []

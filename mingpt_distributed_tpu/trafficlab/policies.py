@@ -15,6 +15,9 @@ lab actually compares, keyed by name for CLI/report use:
   counts, and because the fleet router deliberately does NOT call
   ``on_admit`` (serving/admission.py), sharing one policy object across
   router + replicas counts each admission exactly once.
+* ``health`` — lives in ``serving/admission.py`` (it is a serving-side
+  policy, registered here for grading): FIFO while the fleet is
+  healthy, EDF once any routable replica fails a health gate.
 
 Every ``sort_key`` ends in the queue position, so equal-priority
 requests keep FIFO order and the whole schedule stays deterministic on
@@ -25,7 +28,11 @@ from __future__ import annotations
 
 from typing import Any, Dict, Tuple
 
-from mingpt_distributed_tpu.serving.admission import AdmissionPolicy, FifoPolicy
+from mingpt_distributed_tpu.serving.admission import (
+    AdmissionPolicy,
+    FifoPolicy,
+    HealthAwarePolicy,
+)
 
 __all__ = [
     "POLICIES",
@@ -79,6 +86,9 @@ POLICIES = {
     "fifo": FifoPolicy,
     "edf": DeadlinePolicy,
     "fair": FairSharePolicy,
+    # FIFO while healthy, EDF once any routable replica degrades; the
+    # runner binds the live signals seam per cell (ISSUE 20)
+    "health": HealthAwarePolicy,
 }
 
 

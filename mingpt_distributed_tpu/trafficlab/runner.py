@@ -35,8 +35,18 @@ byte-replayable, partitions included.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Optional, Tuple
+import hashlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
+# control submodules are imported directly (never ``control/__init__``):
+# the package facade pulls in the trace importer, which imports
+# trafficlab.arrivals — going through it from here would be a cycle
+from mingpt_distributed_tpu.control.controller import (
+    SLOAutoscaler,
+    parse_controller_spec,
+)
+from mingpt_distributed_tpu.control.cost import cost_from_cell
+from mingpt_distributed_tpu.control.signals import FleetSignalsView
 from mingpt_distributed_tpu.serving.fleet import (
     ReplicaSupervisor,
     Router,
@@ -111,6 +121,13 @@ class SweepSpec:
     #: / host_kill) — requires n_hosts > 1
     net_chaos_spec: Optional[str] = None
     heartbeat_interval_s: float = 0.05
+    #: controller axis (ISSUE 20): each entry is ``"static"`` (no
+    #: control loop — the historical behaviour) or an ``auto[:k=v...]``
+    #: SLOAutoscaler spec. Every policy runs once per controller on the
+    #: identical rung trace; autoscaled cells are labelled
+    #: ``policy+auto`` in the report so static and controlled runs of
+    #: the same policy grade side by side.
+    controllers: Tuple[str, ...] = ("static",)
 
     def effective_slo(self) -> str:
         """The SLO spec with the recovery-tail objective folded in."""
@@ -160,6 +177,16 @@ class SweepSpec:
             raise ValueError(
                 f"heartbeat_interval_s must be > 0, got "
                 f"{self.heartbeat_interval_s}")
+        if not self.controllers or (
+                len(set(self.controllers)) != len(self.controllers)):
+            raise ValueError(f"bad controller list {self.controllers}")
+        for ctrl in self.controllers:
+            if (parse_controller_spec(ctrl) is not None
+                    and self.n_hosts > 1):
+                raise ValueError(
+                    "autoscaled cells actuate the thread fleet's "
+                    "router/supervisor seams; on a host mesh use "
+                    "controllers=('static',)")
         parse_slo_spec(self.effective_slo())
 
 
@@ -252,7 +279,7 @@ def _run_one_crosshost(params, cfg, spec: SweepSpec, policy_name: str,
             deadline_total += 1
             if outcome in ("length", "eos"):
                 deadline_hit += 1
-    return {
+    cell = {
         "slo": evaluate_slos(rows, parse_slo_spec(spec.effective_slo())),
         "deadline_hit_rate": (
             (deadline_hit / deadline_total) if deadline_total else None),
@@ -267,12 +294,21 @@ def _run_one_crosshost(params, cfg, spec: SweepSpec, policy_name: str,
         "rounds": rounds,
         "virtual_duration_s": clock.now(),
     }
+    cell["cost"] = cost_from_cell(cell)
+    return cell
 
 
 def _run_one(params, cfg, spec: SweepSpec, policy_name: str,
              timed: List[TimedRequest],
-             server_kwargs: Optional[Dict[str, Any]]) -> Dict[str, Any]:
-    """One (rung, policy) cell: fresh fleet, replayed trace, SLO rows."""
+             server_kwargs: Optional[Dict[str, Any]],
+             controller_spec: Optional[str] = None,
+             control_sink: Optional[Callable[[str], None]] = None,
+             ) -> Dict[str, Any]:
+    """One (rung, policy, controller) cell: fresh fleet, replayed
+    trace, SLO rows — plus, when ``controller_spec`` is an ``auto:``
+    spec, an :class:`SLOAutoscaler` attached to the router (the control
+    tick rides ``router.step()``, so the whole closed loop replays
+    byte-identically on the cell's VirtualClock)."""
     if spec.n_hosts > 1:
         return _run_one_crosshost(params, cfg, spec, policy_name, timed,
                                   server_kwargs)
@@ -292,6 +328,16 @@ def _run_one(params, cfg, spec: SweepSpec, policy_name: str,
     router = Router(
         supervisor, trace_recorder=recorder, admission_policy=policy,
         shed_watermark=spec.shed_watermark)
+    if hasattr(policy, "bind"):
+        # health-aware admission reads live fleet state through the
+        # signals seam; binding after the router exists closes the loop
+        policy.bind(FleetSignalsView(router))
+    controller = None
+    if controller_spec is not None:
+        ccfg = parse_controller_spec(controller_spec)
+        if ccfg is not None:
+            controller = SLOAutoscaler(router, ccfg)
+            router.controller = controller
 
     handles: Dict[str, Any] = {}
     shed: Dict[str, str] = {}
@@ -351,7 +397,7 @@ def _run_one(params, cfg, spec: SweepSpec, policy_name: str,
             deadline_total += 1
             if outcome in ("length", "eos"):
                 deadline_hit += 1
-    return {
+    cell = {
         "slo": evaluate_slos(rows, parse_slo_spec(spec.effective_slo())),
         "deadline_hit_rate": (
             (deadline_hit / deadline_total) if deadline_total else None),
@@ -368,14 +414,54 @@ def _run_one(params, cfg, spec: SweepSpec, policy_name: str,
         "rounds": rounds,
         "virtual_duration_s": clock.now(),
     }
+    cell["cost"] = cost_from_cell(cell)
+    if controller is not None:
+        log_text = controller.render_log()
+        cell["control"] = {
+            "spec": controller_spec,
+            "ticks": controller.tick,
+            "actions": controller.action_counts(),
+            "final_replicas": sum(
+                1 for rep in supervisor.replicas
+                if rep.state != "drained" and not rep.draining),
+            "log_sha256": hashlib.sha256(
+                log_text.encode("utf-8")).hexdigest(),
+        }
+        if control_sink is not None:
+            control_sink(log_text)
+    return cell
+
+
+def _cell_plan(spec: SweepSpec) -> List[Tuple[str, str, Optional[str]]]:
+    """``(label, policy, controller_spec_or_None)`` per cell,
+    policy-major. "static" keeps the bare policy name so
+    single-controller reports are shaped exactly as before ISSUE 20;
+    auto controllers suffix ``+auto`` (indexed when several)."""
+    auto_specs = [c for c in spec.controllers
+                  if parse_controller_spec(c) is not None]
+    plan: List[Tuple[str, str, Optional[str]]] = []
+    for policy in spec.policies:
+        for ctrl in spec.controllers:
+            if parse_controller_spec(ctrl) is None:
+                plan.append((policy, policy, None))
+            else:
+                suffix = ("auto" if len(auto_specs) == 1
+                          else f"auto{auto_specs.index(ctrl)}")
+                plan.append((f"{policy}+{suffix}", policy, ctrl))
+    return plan
 
 
 def run_sweep(params, cfg, spec: SweepSpec,
               mix: Optional[WorkloadMix] = None,
               server_kwargs: Optional[Dict[str, Any]] = None,
+              control_log_sink: Optional[
+                  Callable[[int, str, str], None]] = None,
               ) -> Dict[str, Any]:
-    """Run the full ladder x policy grid; returns a validated
-    mingpt-traffic/1 report dict (see report.py for the shape)."""
+    """Run the full ladder x policy x controller grid; returns a
+    validated mingpt-traffic/1 report dict (see report.py for the
+    shape). ``control_log_sink(rung_index, cell_label, log_text)``
+    receives each autoscaled cell's full mingpt-control/1 document —
+    the report itself carries only its sha256."""
     spec.validate()
     if mix is None:
         mix = default_mix(vocab_size=cfg.vocab_size,
@@ -389,6 +475,8 @@ def run_sweep(params, cfg, spec: SweepSpec,
         raise ValueError(
             f"knee objective {knee_objective!r} not in SLO spec "
             f"{spec.slo!r}")
+    plan = _cell_plan(spec)
+    labels = [label for label, _, _ in plan]
     rungs: List[Dict[str, Any]] = []
     for rung_idx, factor in enumerate(spec.ladder):
         scaled = base.scaled(factor)
@@ -396,11 +484,15 @@ def run_sweep(params, cfg, spec: SweepSpec,
         # rendering draws from an RNG keyed by (seed, mix) only, so
         # every rung offers the SAME request bodies, just faster
         timed = mix.render(times, spec.seed)
-        cells = {
-            policy: _run_one(params, cfg, spec, policy, timed,
-                             server_kwargs)
-            for policy in spec.policies
-        }
+        cells = {}
+        for label, policy, ctrl in plan:
+            sink = None
+            if control_log_sink is not None and ctrl is not None:
+                sink = (lambda text, r=rung_idx, lb=label:
+                        control_log_sink(r, lb, text))
+            cells[label] = _run_one(params, cfg, spec, policy, timed,
+                                    server_kwargs, controller_spec=ctrl,
+                                    control_sink=sink)
         rungs.append({
             "rung": rung_idx,
             "load_factor": float(factor),
@@ -421,10 +513,14 @@ def run_sweep(params, cfg, spec: SweepSpec,
         "fleet": {"n_replicas": spec.n_replicas, "n_slots": spec.n_slots,
                   "tick_s": spec.tick_s, "n_hosts": spec.n_hosts},
         "ladder": [float(f) for f in spec.ladder],
-        "policies": list(spec.policies),
+        "controllers": list(spec.controllers),
+        # cell labels, not bare policy names: report consumers (knees,
+        # validation, rendering) treat each (policy, controller) pair
+        # as its own graded column
+        "policies": labels,
         "rungs": rungs,
     }
-    report["knees"] = locate_knees(rungs, spec.policies)
+    report["knees"] = locate_knees(rungs, labels)
     report["knee"] = headline_knee(report)
     validate_traffic_report(report, strict=True)
     return report

@@ -295,3 +295,15 @@ env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
     JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
     JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
     python traffic.py --selftest-traffic
+
+# Control-plane gate (ISSUE 20): a down-ramp overload sweep graded
+# twice on the identical arrival trace — FIFO static vs FIFO under the
+# SLO autoscaler. The autoscaled cell must actually actuate (scale up
+# AND back down via drains), strictly beat static on deadline hit-rate
+# AND on the cost model's headline scalar, and the whole run must be
+# byte-identically replayable: the mingpt-traffic/1 report and every
+# mingpt-control/1 decision log compare equal across two runs.
+env PYTHONPATH= PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    JAX_COMPILATION_CACHE_DIR="$(pwd)/.jax_test_cache" \
+    JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS=1 \
+    python traffic.py --selftest-controller

@@ -65,6 +65,15 @@ replicas under an injected crash-mid-decode + slow replica and asserts
 greedy parity with solo generate(), zero duplicate streamed tokens and
 the breaker/retry/shed counters on a strict-parsed /metrics scrape.
 
+Control plane (ISSUE 20): --autoscale SPEC attaches the SLO autoscaler
+(mingpt_distributed_tpu/control) to the fleet router — it watches live
+TTFT/ITL quantiles and queue depth each scheduling round and actuates
+replica count (spawn / drain-then-retire), speculation gating, prefill
+chunking and the shed watermark under hysteresis + cooldown;
+--slo-target X is shorthand for --autoscale auto:target=X;
+--control-log PATH appends each mingpt-control/1 decision row live.
+Either flag implies the fleet path even at --replicas 1.
+
 Observability knobs (ISSUE 10): --trace-jsonl PATH exports one
 ``mingpt-trace/1`` record stream per request (spans + emit events + a
 request summary), --trace-sample P samples the happy path (errors,
@@ -193,6 +202,18 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="serve through N supervised in-process engine "
                         "replicas behind the health/affinity router "
                         "(default 1: single server, no fleet layer)")
+    p.add_argument("--autoscale", default=None, metavar="SPEC",
+                   help="attach the SLO autoscaler to the fleet router: "
+                        "'auto[:k=v...]' (control/controller.py grammar), "
+                        "e.g. auto:metric=ttft_p99:target=0.05:"
+                        "max_replicas=4; implies the fleet path even at "
+                        "--replicas 1")
+    p.add_argument("--slo-target", type=float, default=None, metavar="X",
+                   help="shorthand for --autoscale auto:target=X (TTFT "
+                        "p99 seconds the controller defends)")
+    p.add_argument("--control-log", default=None, metavar="PATH",
+                   help="append each mingpt-control/1 autoscaler "
+                        "decision row to this JSONL file as it is made")
     p.add_argument("--shed-watermark", type=int, default=None,
                    help="fleet mode: shed new requests once the fleet-wide "
                         "queue depth reaches this watermark")
@@ -2582,6 +2603,26 @@ def selftest_crosshost(args) -> int:
     return rc
 
 
+def _autoscale_spec(args):
+    """Resolve --autoscale / --slo-target into one controller spec (or
+    None), failing fast on a malformed spec. ``--autoscale static`` is
+    an explicit no-op so scripts can parameterize the flag."""
+    spec = args.autoscale
+    if spec is None and args.slo_target is not None:
+        spec = f"auto:target={args.slo_target}"
+    if spec is None:
+        return None
+    from mingpt_distributed_tpu.control.controller import (
+        parse_controller_spec,
+    )
+    try:
+        if parse_controller_spec(spec) is None:
+            return None
+    except ValueError as e:
+        raise SystemExit(f"bad --autoscale spec: {e}")
+    return spec
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     if args.selftest_procfleet:
@@ -2651,8 +2692,25 @@ def main(argv=None) -> int:
     recorder, flight = _make_observability(args, reg)
     spec_kw = _spec_kwargs(args, params, gpt_cfg)
     mesh_kw = _mesh_kwargs(args)
+    autoscale = _autoscale_spec(args)
     if tserver is not None and flight is not None:
         tserver.flight_provider = lambda: flight.snapshot("on_demand")
+
+    def attach_controller(router):
+        """Hang the SLO autoscaler off the router; the control tick
+        rides router.step(), so no extra thread is needed."""
+        if not autoscale:
+            return
+        from mingpt_distributed_tpu.control.controller import (
+            SLOAutoscaler,
+            parse_controller_spec,
+        )
+        router.controller = SLOAutoscaler(
+            router, parse_controller_spec(autoscale),
+            log_path=args.control_log)
+        print("[serve] SLO autoscaler attached (" + autoscale + ")"
+              + (f"; decisions -> {args.control_log}"
+                 if args.control_log else ""), file=sys.stderr)
 
     def build_backend(stream_cb):
         """One InferenceServer by default; --replicas N puts the fleet
@@ -2704,6 +2762,7 @@ def main(argv=None) -> int:
             router = ProcRouter(supervisor, on_token=stream_cb,
                                 shed_watermark=args.shed_watermark,
                                 trace_recorder=recorder, flight=flight)
+            attach_controller(router)
             if tserver is not None:
                 tserver.health_provider = router.health_report
                 # fleet scrape over RPC: worker /metrics pages merged
@@ -2712,7 +2771,7 @@ def main(argv=None) -> int:
                 if args.attrib_json:
                     tserver.attrib_provider = router.attrib_report
             return router
-        if args.replicas > 1:
+        if args.replicas > 1 or autoscale:
             from mingpt_distributed_tpu.serving import (
                 ReplicaSupervisor,
                 Router,
@@ -2740,6 +2799,7 @@ def main(argv=None) -> int:
             router = Router(supervisor, on_token=stream_cb,
                             shed_watermark=args.shed_watermark,
                             trace_recorder=recorder, flight=flight)
+            attach_controller(router)
             if tserver is not None:
                 tserver.health_provider = router.health_report
                 # fleet-wide observability (ISSUE 13): union scrape page

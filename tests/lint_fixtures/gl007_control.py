@@ -1,0 +1,52 @@
+"""GL007 fixtures — wall-clock temptations in control-plane-shaped code.
+
+The SLO autoscaler's guarantee is that an autoscaled sweep is
+byte-replayable: every governor decision is a function of
+ControlSnapshot fields sampled off the router's injected clock, and
+the ``mingpt-control/1`` log stamps virtual ``now`` values. These
+fixtures are the shapes that would quietly break it.
+
+Positives: a governor that reads ``time.monotonic()`` to decide
+whether the cooldown has expired; a scale-up actuator that really
+sleeps while waiting for the spawned replica to warm.
+Suppressed: one wall-clock tick-duration probe, inline disable.
+Negatives: a telemetry ``*_ts`` stamp on an exported decision record,
+an injectable clock default passed by reference, and a ``*Clock``
+class body.
+"""
+import time
+from time import sleep
+
+
+def cooldown_expired_bad(cooldown_until):
+    return time.monotonic() >= cooldown_until  # expect: GL007
+
+
+def scale_up_bad(supervisor):
+    rep = supervisor.spawn_replica()
+    sleep(0.05)  # expect: GL007
+    return rep
+
+
+def tick_wall_seconds_suppressed():
+    return time.perf_counter()  # graftlint: disable=GL007
+
+
+def export_decision(decision):
+    decision_ts = time.time()  # clean: epoch stamp on an exported record
+    decision["decision_ts"] = decision_ts
+    return decision
+
+
+def govern(clock=time.monotonic):  # clean: injectable reference, not a call
+    return clock
+
+
+class GovernorClock:
+    """The injected clock a governor should be handed instead."""
+
+    def __init__(self):
+        self._now = 0.0
+
+    def now(self):
+        return self._now or time.perf_counter()  # clean: *Clock body

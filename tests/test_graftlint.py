@@ -406,6 +406,37 @@ def test_hostplane_module_clean_under_clock_rule():
     assert res.findings == []  # not even suppressed or baselined ones
 
 
+def test_control_package_clean_under_clock_and_name_rules():
+    """ISSUE 20: an autoscaled sweep is byte-replayable only because
+    every governor decision is a function of ControlSnapshot fields
+    sampled off the router's injected clock — a wall-clock read in the
+    cooldown check or a sleep in an actuator would turn the controller
+    selftest into a wall-time test. The whole package has an explicit
+    GL007 scope entry (Config.clock_paths) and must be clock-clean
+    outright — no suppressions, no baseline entries. Its
+    ``mingpt_control_*`` metric families must also pass the GL008/GL009
+    naming rules unsuppressed. The wall-clock shapes that would break
+    replay are pinned by the gl007_control.py fixture."""
+    pkg = os.path.join(REPO, "mingpt_distributed_tpu", "control")
+    paths = sorted(
+        os.path.join(pkg, f) for f in os.listdir(pkg) if f.endswith(".py"))
+    assert len(paths) >= 5  # __init__, signals, cost, controller, importer
+    cfg = Engine(select=["GL007"], root=REPO).config
+    # pinned explicitly: narrowing clock_paths later must not silently
+    # drop the control plane from scope
+    assert "control/" in cfg.clock_paths
+    for p in paths:
+        rel = os.path.relpath(p, REPO)
+        assert cfg.clock_in_scope(rel), f"{rel} fell out of GL007 scope"
+    res = Engine(select=["GL007"], root=REPO).run(paths)
+    assert not res.parse_errors
+    assert res.findings == []  # not even suppressed or baselined ones
+
+    res = Engine(select=["GL008", "GL009"], root=REPO).run(paths)
+    assert not res.parse_errors
+    assert res.findings == []
+
+
 def test_attribution_module_clean_under_clock_and_name_rules():
     """ISSUE 13: the attribution ledger's byte-identical-report
     guarantee (two VirtualClock serving runs must dump the same

@@ -31,6 +31,15 @@ Input kinds (both files must be the same kind):
   15): exact-match semantics on every ``sweep.program.metric`` leaf —
   budgets are compiled-program properties, so no tolerance applies and
   any flops/bytes growth is a regression.
+* ``mingpt-traffic/1`` sweep reports (``traffic.py --out``, ISSUE 20):
+  cells are matched per (rung, cell label) — labels carry the
+  controller axis, so ``fifo`` and ``fifo+auto`` diff as separate
+  columns — and two per-cell metrics are compared:
+  ``deadline_hit_rate`` (HIGHER-is-better, noise-tolerant so tiny
+  trace perturbations between configurations don't flag) and the cost
+  model's headline ``cost`` scalar (lower-is-better, EXACT: same-seed
+  VirtualClock sweeps are byte-identical, so any drift is a real
+  behaviour change). Cells present on one side only render n/a.
 
 Verdicts per metric: ``same`` | ``improved`` | ``regressed`` | ``n/a``
 (the ``diff_slo_reports`` vocabulary, with ``improved`` instead of
@@ -50,6 +59,7 @@ from typing import Any, Dict, List, Optional
 
 ATTRIB_SCHEMA = "mingpt-attrib/1"
 BUDGETS_SCHEMA = "graftaudit-budgets/1"
+TRAFFIC_SCHEMA = "mingpt-traffic/1"
 
 #: attrib metrics compared per program row, in render order. The bool
 #: is "timing?": timing metrics get the noise thresholds, exact ones
@@ -79,11 +89,13 @@ def _telemetry():
 
 
 def classify(path: str, doc: Any) -> str:
-    """'attrib' | 'bench' | 'budgets' (ValueError otherwise)."""
+    """'attrib' | 'bench' | 'budgets' | 'traffic' (ValueError otherwise)."""
     if isinstance(doc, dict) and doc.get("schema") == ATTRIB_SCHEMA:
         return "attrib"
     if isinstance(doc, dict) and doc.get("schema") == BUDGETS_SCHEMA:
         return "budgets"
+    if isinstance(doc, dict) and doc.get("schema") == TRAFFIC_SCHEMA:
+        return "traffic"
     if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict) \
             and "metric" in doc["parsed"]:
         return "bench"
@@ -316,6 +328,62 @@ def diff_budget_reports(
     }
 
 
+def diff_traffic_reports(
+    a: Dict[str, Any],
+    b: Dict[str, Any],
+    rel_tol: float = 0.05,
+) -> Dict[str, Any]:
+    """Diff two mingpt-traffic/1 sweep reports per (rung, cell label).
+
+    ``deadline_hit_rate`` is HIGHER-is-better with the relative noise
+    tolerance (comparing two *configurations* legitimately perturbs a
+    handful of requests); the cost model's ``cost`` scalar is exact
+    lower-is-better — it is integer-derived and byte-stable on
+    VirtualClock, so any drift is a real behaviour change. A cell (or
+    the ``cost`` block, in pre-controller reports) present on only one
+    side renders n/a, never a regression."""
+    for label, doc in (("a", a), ("b", b)):
+        if doc.get("schema") != TRAFFIC_SCHEMA or \
+                not isinstance(doc.get("rungs"), list):
+            raise ValueError(
+                f"report {label}: not a {TRAFFIC_SCHEMA} report")
+
+    def _cells(doc):
+        out = {}
+        for rung in doc["rungs"]:
+            for cell_label, cell in sorted(
+                    rung.get("policies", {}).items()):
+                out[(int(rung["rung"]), cell_label)] = cell
+        return out
+
+    ca, cb = _cells(a), _cells(b)
+    rows = []
+    for key in sorted(set(ca) | set(cb)):
+        rung, cell_label = key
+        xa, xb = ca.get(key), cb.get(key)
+        hit = _verdict(
+            None if xa is None else xa.get("deadline_hit_rate"),
+            None if xb is None else xb.get("deadline_hit_rate"),
+            rel_tol, 0.0, lower_better=False)
+        rows.append({
+            "metric": f"rung{rung}.{cell_label}.deadline_hit_rate",
+            "unit": None, "direction": "higher_better", **hit})
+        cost = _verdict(
+            None if xa is None else (xa.get("cost") or {}).get("cost"),
+            None if xb is None else (xb.get("cost") or {}).get("cost"),
+            1e-9, 0.0)
+        rows.append({
+            "metric": f"rung{rung}.{cell_label}.cost",
+            "unit": None, "direction": "lower_better", **cost})
+    return {
+        "schema": f"{TRAFFIC_SCHEMA}-diff",
+        "rel_tol": rel_tol,
+        "metrics": rows,
+        "regressions": sum(
+            1 for r in rows if r["verdict"] == "regressed"),
+    }
+
+
 def diff_bench_reports(
     a: Dict[str, Any],
     b: Dict[str, Any],
@@ -418,6 +486,9 @@ def main(argv=None) -> int:
                 abs_floor_s=args.abs_floor_s)
         elif kinds[0] == "budgets":
             diff = diff_budget_reports(docs[0], docs[1])
+        elif kinds[0] == "traffic":
+            diff = diff_traffic_reports(
+                docs[0], docs[1], rel_tol=args.rel_tol)
         else:
             diff = diff_bench_reports(
                 docs[0], docs[1], rel_tol=args.rel_tol)

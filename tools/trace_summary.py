@@ -10,6 +10,7 @@ process/thread lane, complete events ("ph": "X") are summed by name.
 Usage: python tools/trace_summary.py DIR [--top N]
        python tools/trace_summary.py SPANS.jsonl [--top N]
        python tools/trace_summary.py TRACE.jsonl [--slo [SPEC]]
+       python tools/trace_summary.py CONTROL.jsonl [--top N]
        python tools/trace_summary.py ATTRIB.json
        python tools/trace_summary.py --compare A.json B.json
 
@@ -27,6 +28,13 @@ the emitted-token window in submit-relative time, with retry attempts
 flagged. ``--slo [SPEC]`` additionally grades the request summaries
 against named objectives (exact quantiles, telemetry.slo) and prints
 the attainment report.
+
+A ``.jsonl`` whose records carry the ``mingpt-control/1`` schema
+(written by ``serve.py --control-log`` or collected from a trafficlab
+autoscaled cell, ISSUE 20) is an SLO-autoscaler decision log: rendered
+as the per-actuator action table (ups/downs per lever), the actuation
+timeline in virtual time, and the grouped reason mix — what the
+controller saw (values elided) and how often, holds included.
 
 A ``.json`` file argument carrying the ``mingpt-attrib/1`` schema
 (written by ``serve.py --attrib-json``, ISSUE 13) is a performance
@@ -54,11 +62,13 @@ import glob
 import gzip
 import json
 import os
+import re
 import sys
 from collections import defaultdict
 
 
 TRACE_SCHEMA = "mingpt-trace/1"
+CONTROL_SCHEMA = "mingpt-control/1"
 
 
 def _telemetry():
@@ -142,6 +152,79 @@ def summarize_requests(traces: dict) -> list[str]:
                 f"emit x{len(emits)} (first..last token)"))
         rows.sort(key=lambda kv: kv[0])
         out.extend(line for _, line in rows)
+    return out
+
+
+def load_control_jsonl(path: str) -> list[dict]:
+    """Strict-load a ``mingpt-control/1`` decision log (one JSON row
+    per evaluated controller tick)."""
+    rows = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if not line.strip():
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError as e:
+                raise ValueError(f"line {i + 1}: not JSON ({e})")
+            if rec.get("schema") != CONTROL_SCHEMA:
+                raise ValueError(
+                    f"line {i + 1}: schema {rec.get('schema')!r}, "
+                    f"want {CONTROL_SCHEMA!r}")
+            missing = [k for k in ("tick", "now", "action", "reason")
+                       if k not in rec]
+            if missing:
+                raise ValueError(f"line {i + 1}: missing keys {missing}")
+            rows.append(rec)
+    if not rows:
+        raise ValueError(f"no {CONTROL_SCHEMA} rows in {path}")
+    return rows
+
+
+def _reason_key(reason: str) -> str:
+    """Group controller reasons by shape: the observed values vary per
+    tick, the comparison they triggered doesn't — elide the numbers so
+    the mix table counts regimes, not floats."""
+    return re.sub(r"-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?", "*",
+                  reason.split(";", 1)[0].strip())
+
+
+def summarize_control(rows: list[dict], top: int = 12) -> list[str]:
+    """Render one autoscaler decision log: action table per actuator,
+    the actuation timeline in virtual time, and the grouped reason
+    mix (holds included — what the controller saw and declined on)."""
+    t0, t1 = float(rows[0]["now"]), float(rows[-1]["now"])
+    metric = rows[0].get("metric", "?")
+    acted = [r for r in rows
+             if r["action"].get("direction") != "hold"]
+    out = [
+        f"control log ({CONTROL_SCHEMA}): {len(rows)} ticks over "
+        f"{t1 - t0:.3f}s, metric={metric}",
+        f"actions: {len(acted)} (holds: {len(rows) - len(acted)})",
+    ]
+    counts: dict = defaultdict(lambda: defaultdict(int))
+    for r in acted:
+        counts[r["action"]["actuator"]][r["action"]["direction"]] += 1
+    for actuator in sorted(counts):
+        for direction in sorted(counts[actuator]):
+            out.append(f"  {actuator:<16} {direction:<5} "
+                       f"{counts[actuator][direction]:>4}")
+    if acted:
+        out.append("\ntimeline:")
+        for r in acted:
+            out.append(
+                f"  tick {r['tick']:>4} +{float(r['now']) - t0:8.3f}s  "
+                f"{r['action']['actuator']:<14} "
+                f"{r['action']['direction']:<4} {r['reason']}")
+    out.append("\nreason mix:")
+    mix: dict = defaultdict(int)
+    for r in rows:
+        mix[_reason_key(r["reason"])] += 1
+    ranked = sorted(mix.items(), key=lambda kv: kv[1], reverse=True)
+    for key, n in ranked[:top]:
+        out.append(f"  {n:>5}x  {key}")
+    if len(ranked) > top:
+        out.append(f"  (+{len(ranked) - top} more reason shapes)")
     return out
 
 
@@ -273,8 +356,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("profile_dir", nargs="?", default=None,
                     help="profiler output dir, a telemetry span .jsonl, "
-                         "a mingpt-trace/1 request-trace .jsonl, or a "
-                         "mingpt-attrib/1 attribution report .json "
+                         "a mingpt-trace/1 request-trace .jsonl, a "
+                         "mingpt-control/1 autoscaler decision .jsonl, "
+                         "or a mingpt-attrib/1 attribution report .json "
                          "(omitted with --compare)")
     ap.add_argument("--top", type=int, default=12)
     ap.add_argument("--compare", nargs=2, default=None,
@@ -350,6 +434,15 @@ def main(argv=None) -> int:
                 [t["request"] for t in traces.values()],
                 tel.parse_slo_spec(args.slo))
             print(tel.render_slo_report(report))
+        return 0
+    if span_input and sniff_jsonl_schema(args.profile_dir) == CONTROL_SCHEMA:
+        # fourth input kind (ISSUE 20): an SLO-autoscaler decision log
+        try:
+            rows = load_control_jsonl(args.profile_dir)
+        except (OSError, ValueError) as e:
+            print(f"invalid {CONTROL_SCHEMA} stream: {e}", file=sys.stderr)
+            return 1
+        print("\n".join(summarize_control(rows, args.top)))
         return 0
     if args.slo is not None:
         print("--slo needs a mingpt-trace/1 request-trace .jsonl input",

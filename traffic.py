@@ -36,7 +36,9 @@ period=..:duty=.. | ramp:rate0=..:rate1=..:duration=..), --ladder
 (fleet geometry), --slo SPEC (telemetry/slo.py grammar),
 --knee-objective NAME (default: first objective), --chaos-spec SPEC
 (ServingFaultInjector grammar — the same sweep graded under crashes),
---shed-watermark D, --prefix-cache-mb M, --out PATH (report JSON).
+--controllers "static,auto:..." (SLO-autoscaler axis: every policy
+runs once per controller on the identical trace), --shed-watermark D,
+--prefix-cache-mb M, --out PATH (report JSON).
 """
 
 from __future__ import annotations
@@ -84,6 +86,12 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="NetworkFaultInjector spec (partition / "
                         "drop_frame / slow_link / host_kill) over the "
                         "host mesh; needs --hosts >= 2")
+    p.add_argument("--controllers", default="static",
+                   help="comma-separated controller axis: each entry is "
+                        "'static' or an 'auto[:k=v...]' SLO-autoscaler "
+                        "spec; every policy runs once per controller on "
+                        "the identical trace (autoscaled cells are "
+                        "labelled policy+auto)")
     p.add_argument("--shed-watermark", type=int, default=None,
                    help="fleet-wide queue depth that sheds new arrivals")
     p.add_argument("--prefix-cache-mb", type=float, default=0.0,
@@ -98,6 +106,13 @@ def build_argparser() -> argparse.ArgumentParser:
                    help="tiny random-init model, canned 2-rung FIFO/EDF "
                         "sweep; asserts knee + policy separation + "
                         "byte-identical replay, then exits")
+    p.add_argument("--selftest-controller", action="store_true",
+                   help="tiny random-init model, one down-ramp rung, "
+                        "static vs SLO-autoscaled cells on the identical "
+                        "trace; asserts the controller scales up AND back "
+                        "down, beats static on deadline hit-rate and "
+                        "cost, and replays byte-identically (report and "
+                        "mingpt-control/1 log), then exits")
     p.add_argument("overrides", nargs="*")
     return p
 
@@ -131,6 +146,8 @@ def _sweep_spec(args):
         chaos_spec=args.chaos_spec,
         n_hosts=args.hosts,
         net_chaos_spec=args.net_chaos_spec,
+        controllers=tuple(c.strip() for c in args.controllers.split(",")
+                          if c.strip()),
         shed_watermark=args.shed_watermark,
         prefix_cache_mb=args.prefix_cache_mb,
     )
@@ -254,10 +271,123 @@ def selftest_traffic(args) -> int:
     return rc
 
 
+AUTO_SPEC = ("auto:metric=queue_depth:target=2.0:comfort=0.5"
+             ":interval_s=0.002:cooldown_s=0.02:up_after=2:down_after=5"
+             ":min_replicas=1:max_replicas=3")
+
+
+def selftest_controller_spec():
+    """Canned controller geometry: a DOWN-ramp so one cell exercises
+    both directions — the early burst (~300/s against a 1x2-slot
+    fleet) forces scale-ups, the sparse tail (~6/s) leaves the extra
+    replicas comfortable long enough to drain back down."""
+    from mingpt_distributed_tpu.trafficlab import SweepSpec
+
+    return SweepSpec(
+        arrival="ramp:rate0=1400.0:rate1=4.0:duration=0.04",
+        ladder=(1.0,),
+        policies=("fifo",),
+        controllers=("static", AUTO_SPEC),
+        n_requests=36,
+        seed=0,
+        n_replicas=1,
+        n_slots=2,
+        slo="ttft_p95<=0.025,shed_rate<=0.5",
+        prefix_cache_mb=0.5,
+    )
+
+
+def selftest_controller(args) -> int:
+    """The CI gate (run_tests.sh --selftest-controller). Static and
+    autoscaled cells replay the IDENTICAL down-ramp trace; asserts the
+    controller logs >= 1 replica scale-up and >= 1 scale-down, beats
+    the static fleet on deadline hit-rate AND cost-model cost at the
+    overload rung, the report strict-parses, every control-log line is
+    a valid mingpt-control/1 row, and a rerun reproduces both the
+    report and the control log byte-for-byte."""
+    import json
+
+    from mingpt_distributed_tpu.control.controller import CONTROL_SCHEMA
+    from mingpt_distributed_tpu.trafficlab import (
+        render_traffic_report,
+        run_sweep,
+        validate_traffic_report,
+    )
+    from mingpt_distributed_tpu.trafficlab.report import dump_report
+
+    cfg, params = _tiny_model()
+    spec = selftest_controller_spec()
+    mix = selftest_mix()
+
+    def run_once():
+        logs = {}
+        report = run_sweep(
+            params, cfg, spec, mix=mix,
+            control_log_sink=lambda r, lb, text: logs.__setitem__(
+                (r, lb), text))
+        return report, logs
+
+    report, logs = run_once()
+    print(render_traffic_report(report))
+
+    rc = 0
+
+    def check(ok: bool, what: str) -> None:
+        nonlocal rc
+        print(f"selftest-controller {'OK' if ok else 'FAIL'}: {what}")
+        if not ok:
+            rc = 1
+
+    parsed = json.loads(dump_report(report))
+    problems = validate_traffic_report(parsed, strict=False)
+    check(not problems, f"report strict-parses (problems={problems})")
+    check(parsed["policies"] == ["fifo", "fifo+auto"],
+          f"cell labels carry the controller axis ({parsed['policies']})")
+
+    rung = parsed["rungs"][0]
+    static_cell = rung["policies"]["fifo"]
+    auto_cell = rung["policies"]["fifo+auto"]
+    control = auto_cell.get("control") or {}
+    rep_actions = (control.get("actions") or {}).get("replicas", {})
+    check(rep_actions.get("up", 0) >= 1,
+          f"controller scaled up (replica actions={rep_actions})")
+    check(rep_actions.get("down", 0) >= 1,
+          f"controller scaled back down (replica actions={rep_actions})")
+
+    s_hit, a_hit = (static_cell["deadline_hit_rate"],
+                    auto_cell["deadline_hit_rate"])
+    check(s_hit is not None and a_hit is not None and a_hit > s_hit,
+          f"autoscaled beats static on deadline hit-rate "
+          f"(auto={a_hit} static={s_hit})")
+    s_cost, a_cost = static_cell["cost"]["cost"], auto_cell["cost"]["cost"]
+    check(a_cost < s_cost,
+          f"autoscaled cell is cheaper under the cost model "
+          f"(auto={a_cost:.6g} static={s_cost:.6g})")
+
+    log_text = logs.get((0, "fifo+auto"), "")
+    rows = [json.loads(line) for line in log_text.splitlines()]
+    check(bool(rows) and all(r.get("schema") == CONTROL_SCHEMA
+                             for r in rows),
+          f"control log is valid {CONTROL_SCHEMA} JSONL ({len(rows)} rows)")
+    check(control.get("ticks") == len(rows),
+          f"cell ticks match log rows ({control.get('ticks')} vs "
+          f"{len(rows)})")
+
+    report2, logs2 = run_once()
+    check(dump_report(report) == dump_report(report2),
+          "same-seed rerun report is byte-identical")
+    check(logs == logs2, "same-seed rerun control log is byte-identical")
+
+    print("selftest-controller " + ("PASSED" if rc == 0 else "FAILED"))
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_argparser().parse_args(argv)
     if args.selftest_traffic:
         return selftest_traffic(args)
+    if args.selftest_controller:
+        return selftest_controller(args)
 
     from mingpt_distributed_tpu.config import load_config
     from mingpt_distributed_tpu.trafficlab import (
